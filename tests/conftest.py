@@ -1,0 +1,16 @@
+"""Test environment: force an 8-device virtual CPU mesh before jax initializes.
+
+Multi-chip hardware is not available in CI; all sharding tests run against
+``--xla_force_host_platform_device_count=8`` on the CPU backend, per the project
+testing contract.  This must run before any ``import jax`` in the test session.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,220 @@
+"""Aggregator tests (reference behavior: MetricSampleAggregatorTest / RawMetricValuesTest)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.core.aggregator import (
+    AggregationOptions,
+    Extrapolation,
+    MetricSampleAggregator,
+    NotEnoughValidWindowsError,
+    NotEnoughValidEntitiesError,
+)
+from cruise_control_tpu.core.metricdef import MetricDef, ValueStrategy
+
+WINDOW_MS = 1000
+
+
+def _metric_def():
+    return (
+        MetricDef()
+        .define("avg_m", ValueStrategy.AVG)
+        .define("max_m", ValueStrategy.MAX)
+        .define("latest_m", ValueStrategy.LATEST)
+    )
+
+
+def _agg(num_windows=4, min_samples=2):
+    return MetricSampleAggregator(num_windows, WINDOW_MS, min_samples, _metric_def())
+
+
+def fill_window(agg, entity, window, n=2, base=10.0):
+    for i in range(n):
+        ts = window * WINDOW_MS + i * 10
+        agg.add_sample(entity, ts, [base + i, base + i, base + i])
+
+
+def test_strategies_avg_max_latest():
+    agg = _agg()
+    agg.add_sample("p0", 100, [1.0, 5.0, 7.0])
+    agg.add_sample("p0", 200, [3.0, 2.0, 9.0])
+    # advance current window so window 0 becomes stable
+    agg.add_sample("p0", 1 * WINDOW_MS + 1, [0.0, 0.0, 0.0])
+    vae, _ = agg.aggregate()
+    assert vae.window_ids == [0]
+    row = vae.values[vae.entity_index("p0"), 0]
+    assert row[0] == pytest.approx(2.0)   # AVG of 1,3
+    assert row[1] == pytest.approx(5.0)   # MAX of 5,2
+    assert row[2] == pytest.approx(9.0)   # LATEST at ts=200
+
+
+def test_max_strategy_later_sample_wins():
+    agg = _agg()
+    agg.add_sample("p0", 100, [1.0, 1.0, 1.0])
+    agg.add_sample("p0", 200, [2.0, 8.0, 2.0])  # larger max arrives second
+    agg.add_sample("p0", WINDOW_MS + 1, [0.0, 0.0, 0.0])
+    vae, _ = agg.aggregate()
+    assert vae.values[vae.entity_index("p0"), 0, 1] == pytest.approx(8.0)
+
+
+def test_far_future_roll_is_bounded_and_correct():
+    agg = _agg(num_windows=3)
+    fill_window(agg, "p0", 0)
+    # jump a billion windows ahead: must complete fast and evict all history
+    far = 10**9
+    agg.add_sample("p0", far * WINDOW_MS, [1.0, 1.0, 1.0])
+    fill_window(agg, "p0", far)  # no-op extra samples into current window
+    fill_window(agg, "p0", far + 1)
+    vae, _ = agg.aggregate()
+    assert all(w >= far - 3 for w in vae.window_ids)
+    assert agg.add_sample("p0", 0, [1.0, 1.0, 1.0]) is False
+
+
+def test_current_window_excluded():
+    agg = _agg()
+    fill_window(agg, "p0", 0)
+    vae_err = None
+    try:
+        agg.aggregate()
+    except NotEnoughValidWindowsError as e:
+        vae_err = e
+    assert vae_err is not None  # only the current window exists -> nothing stable
+
+
+def test_window_rolling_evicts_old():
+    agg = _agg(num_windows=3)
+    for w in range(6):
+        fill_window(agg, "p0", w)
+    # current=5; stable retained: 2,3,4
+    vae, _ = agg.aggregate()
+    assert vae.window_ids == [2, 3, 4]
+    # too-old sample rejected
+    assert agg.add_sample("p0", 0, [1.0, 1.0, 1.0]) is False
+
+
+def test_extrapolation_avg_available():
+    agg = _agg(min_samples=4)
+    # 2 samples (>= half of 4) -> AVG_AVAILABLE
+    fill_window(agg, "p0", 0, n=2, base=10.0)
+    fill_window(agg, "p0", 1, n=4)  # make window 1 the current roll driver
+    vae, _ = agg.aggregate(options=AggregationOptions(include_invalid_entities=True))
+    i = vae.entity_index("p0")
+    w = vae.window_ids.index(0)
+    assert vae.extrapolations[i, w] == Extrapolation.AVG_AVAILABLE
+    assert vae.values[i, w, 0] == pytest.approx(10.5)
+
+
+def test_extrapolation_forced_insufficient():
+    agg = _agg(min_samples=4)
+    fill_window(agg, "p0", 0, n=1, base=3.0)  # 1 < half of 4
+    fill_window(agg, "p0", 1, n=4)
+    vae, _ = agg.aggregate(options=AggregationOptions(include_invalid_entities=True))
+    i, w = vae.entity_index("p0"), vae.window_ids.index(0)
+    assert vae.extrapolations[i, w] == Extrapolation.FORCED_INSUFFICIENT
+    assert vae.values[i, w, 0] == pytest.approx(3.0)
+
+
+def test_extrapolation_avg_adjacent():
+    agg = _agg(num_windows=4, min_samples=2)
+    fill_window(agg, "p0", 0, base=10.0)   # valid
+    # window 1: no samples at all
+    fill_window(agg, "p0", 2, base=20.0)   # valid
+    fill_window(agg, "p0", 3)              # becomes current-1 driver
+    agg.add_sample("p0", 4 * WINDOW_MS, [0.0, 0.0, 0.0])  # open current window 4
+    vae, _ = agg.aggregate(options=AggregationOptions(include_invalid_entities=True))
+    i, w = vae.entity_index("p0"), vae.window_ids.index(1)
+    assert vae.extrapolations[i, w] == Extrapolation.AVG_ADJACENT
+    # avg of window0 avg (10.5) and window2 avg (20.5)
+    assert vae.values[i, w, 0] == pytest.approx(15.5)
+
+
+def test_no_valid_extrapolation_marks_entity_invalid():
+    agg = _agg(num_windows=4, min_samples=2)
+    fill_window(agg, "good", 0)
+    fill_window(agg, "good", 1)
+    fill_window(agg, "good", 2)
+    fill_window(agg, "good", 3)
+    agg.add_sample("good", 4 * WINDOW_MS, [0.0] * 3)
+    # "bad" entity has a single isolated window; others have no adjacent help
+    fill_window(agg, "bad", 0)
+    vae, completeness = agg.aggregate()
+    assert "bad" not in vae.entities
+    assert "good" in vae.entities
+    assert completeness.valid_entity_ratio == pytest.approx(0.5)
+
+
+def test_completeness_window_requirement_enforced():
+    agg = _agg(num_windows=2, min_samples=2)
+    fill_window(agg, "p0", 0)
+    fill_window(agg, "p0", 1)
+    agg.add_sample("p0", 2 * WINDOW_MS, [0.0] * 3)
+    fill_window(agg, "p1", 2)  # p1 only has current-window samples -> no stable data
+    # p1 covers no stable window, so window coverage is 0.5 < 1.0 everywhere
+    with pytest.raises(NotEnoughValidWindowsError):
+        agg.aggregate(options=AggregationOptions(min_valid_entity_ratio=1.0))
+    with pytest.raises(NotEnoughValidWindowsError):
+        agg.aggregate(options=AggregationOptions(min_valid_entity_ratio=0.9, min_valid_windows=5))
+
+
+def test_completeness_entity_requirement_enforced():
+    # Entity invalid through too many extrapolations while window coverage stays
+    # full (extrapolated windows count toward window coverage, not entity validity).
+    agg = MetricSampleAggregator(2, WINDOW_MS, 2, _metric_def(), max_allowed_extrapolations=0)
+    fill_window(agg, "p0", 0)
+    fill_window(agg, "p0", 1)
+    fill_window(agg, "p1", 0, n=1)  # FORCED_INSUFFICIENT -> extrapolated
+    fill_window(agg, "p1", 1)
+    agg.add_sample("p0", 2 * WINDOW_MS, [0.0] * 3)
+    with pytest.raises(NotEnoughValidEntitiesError):
+        agg.aggregate(options=AggregationOptions(min_valid_entity_ratio=0.9))
+    vae, comp = agg.aggregate(options=AggregationOptions(min_valid_entity_ratio=0.5))
+    assert comp.valid_entity_ratio == pytest.approx(0.5)
+    assert vae.entities == ["p0"]
+
+
+def test_entity_groups_in_completeness():
+    agg = _agg(num_windows=2, min_samples=1)
+    for e, grp in [("t0-0", "t0"), ("t0-1", "t0"), ("t1-0", "t1")]:
+        agg.set_entity_group(e, grp)
+    fill_window(agg, "t0-0", 0, n=1)
+    fill_window(agg, "t0-1", 0, n=1)
+    fill_window(agg, "t1-0", 0, n=1)
+    agg.add_sample("t0-0", 1 * WINDOW_MS, [0.0] * 3)
+    _, comp = agg.aggregate(options=AggregationOptions(include_invalid_entities=True))
+    assert comp.valid_entity_group_ratio == pytest.approx(1.0)
+
+
+def test_generation_increments():
+    agg = _agg()
+    g0 = agg.generation
+    agg.add_sample("p0", 10, [1.0, 1.0, 1.0])
+    assert agg.generation > g0
+
+
+def test_retain_entities():
+    agg = _agg(min_samples=1)
+    fill_window(agg, "p0", 0, n=1)
+    fill_window(agg, "p1", 0, n=1)
+    agg.add_sample("p0", WINDOW_MS, [0.0] * 3)
+    agg.retain_entities(["p1"])
+    vae, _ = agg.aggregate()
+    assert vae.entities == ["p1"]
+
+
+def test_time_range_filtering():
+    agg = _agg(num_windows=4, min_samples=1)
+    for w in range(5):
+        fill_window(agg, "p0", w, n=1)
+    vae, _ = agg.aggregate(from_ms=1 * WINDOW_MS, to_ms=3 * WINDOW_MS)
+    assert vae.window_ids == [1, 2, 3]
+
+
+def test_many_entities_dense_growth():
+    agg = _agg(min_samples=1)
+    for i in range(600):
+        agg.add_sample(f"p{i}", 100, [float(i), float(i), float(i)])
+    agg.add_sample("p0", WINDOW_MS, [0.0] * 3)
+    vae, _ = agg.aggregate()
+    assert len(vae.entities) == 600
+    i = vae.entity_index("p599")
+    assert vae.values[i, 0, 0] == pytest.approx(599.0)

@@ -1,0 +1,94 @@
+"""Config kernel tests (reference behavior: ConfigDef/AbstractConfig unit tests)."""
+
+import pytest
+
+from cruise_control_tpu.core.config import (
+    Config,
+    ConfigDef,
+    ConfigException,
+    Importance,
+    Password,
+    Type,
+    in_range,
+    in_values,
+)
+
+
+def _def():
+    return (
+        ConfigDef()
+        .define("num.windows", Type.INT, 5, Importance.HIGH, "window count", in_range(1, None))
+        .define("ratio", Type.DOUBLE, 0.5, validator=in_range(0.0, 1.0))
+        .define("name", Type.STRING, "cc")
+        .define("enabled", Type.BOOLEAN, True)
+        .define("goals", Type.LIST, "a,b,c")
+        .define("secret", Type.PASSWORD, "hunter2")
+        .define("required.key", Type.INT)
+    )
+
+
+def test_defaults_and_overrides():
+    cfg = Config(_def(), {"required.key": 7, "num.windows": "10"})
+    assert cfg.get_int("num.windows") == 10
+    assert cfg.get_double("ratio") == 0.5
+    assert cfg.get_boolean("enabled") is True
+    assert cfg.get_list("goals") == ["a", "b", "c"]
+    assert cfg.get_int("required.key") == 7
+
+
+def test_missing_required_raises():
+    with pytest.raises(ConfigException, match="required.key"):
+        Config(_def(), {})
+
+
+def test_validator_rejects_out_of_range():
+    with pytest.raises(ConfigException, match="ratio"):
+        Config(_def(), {"required.key": 1, "ratio": 1.5})
+
+
+def test_bool_and_list_parsing():
+    cfg = Config(_def(), {"required.key": 1, "enabled": "false", "goals": ["x", "y"]})
+    assert cfg.get_boolean("enabled") is False
+    assert cfg.get_list("goals") == ["x", "y"]
+
+
+def test_bad_type_raises():
+    with pytest.raises(ConfigException):
+        Config(_def(), {"required.key": "not-an-int"})
+
+
+def test_unknown_keys_tolerated_and_reported():
+    cfg = Config(_def(), {"required.key": 1, "mystery.key": "z"})
+    assert cfg.unknown() == ["mystery.key"]
+
+
+def test_password_redacted():
+    cfg = Config(_def(), {"required.key": 1})
+    assert isinstance(cfg.get("secret"), Password)
+    assert cfg.to_dict()["secret"] == Password.HIDDEN
+    assert "hunter2" not in repr(cfg.get("secret"))
+
+
+def test_in_values_validator():
+    d = ConfigDef().define("mode", Type.STRING, "fast", validator=in_values("fast", "full"))
+    with pytest.raises(ConfigException):
+        Config(d, {"mode": "other"})
+    assert Config(d, {"mode": "full"}).get("mode") == "full"
+
+
+def test_merge_and_double_define():
+    base = ConfigDef().define("a", Type.INT, 1)
+    other = ConfigDef().define("a", Type.INT, 99).define("b", Type.INT, 2)
+    base.merge(other)
+    cfg = Config(base, {})
+    assert cfg.get("a") == 1  # first definition wins
+    assert cfg.get("b") == 2
+    with pytest.raises(ConfigException):
+        base.define("a", Type.INT, 3)
+
+
+def test_configured_instance():
+    d = ConfigDef().define("impl", Type.CLASS, "cruise_control_tpu.core.config.Password")
+    cfg = Config(d, {})
+    with pytest.raises(ConfigException):
+        cfg.get_configured_instance("impl", dict)  # wrong expected type

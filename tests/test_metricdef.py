@@ -1,0 +1,61 @@
+"""Metric taxonomy tests (reference behavior: RawMetricType / KafkaMetricDef)."""
+
+from cruise_control_tpu.core.metricdef import (
+    BROKER_METRIC_DEF,
+    COMMON_METRIC_DEF,
+    COMMON_METRIC_NAMES,
+    MetricScope,
+    RawMetricType,
+    ValueStrategy,
+    raw_metric_scope,
+    raw_types_for_scope,
+    resource_to_metric_ids,
+)
+from cruise_control_tpu.core.resources import Resource
+
+
+def test_raw_taxonomy_counts():
+    # Reference RawMetricType: broker/topic/partition scopes; 43 broker types was the
+    # historical figure, the current tree carries the full queue/local/total-time
+    # percentile families.
+    assert len(raw_types_for_scope(MetricScope.PARTITION)) == 1
+    assert len(raw_types_for_scope(MetricScope.TOPIC)) == 7
+    assert len(raw_types_for_scope(MetricScope.BROKER)) >= 40
+    assert raw_metric_scope(RawMetricType.PARTITION_SIZE) is MetricScope.PARTITION
+
+
+def test_common_def_is_prefix_of_broker_def():
+    common = [m.name for m in COMMON_METRIC_DEF.all()]
+    broker = [m.name for m in BROKER_METRIC_DEF.all()]
+    assert common == COMMON_METRIC_NAMES
+    assert broker[: len(common)] == common
+    # ids are dense column indices
+    assert [m.id for m in BROKER_METRIC_DEF.all()] == list(range(BROKER_METRIC_DEF.size()))
+
+
+def test_strategies():
+    assert COMMON_METRIC_DEF.metric_info("DISK_USAGE").strategy is ValueStrategy.LATEST
+    assert COMMON_METRIC_DEF.metric_info("CPU_USAGE").strategy is ValueStrategy.AVG
+    # All broker-only defs use AVG in the reference (KafkaMetricDef.java:61-101).
+    assert (
+        BROKER_METRIC_DEF.metric_info("BROKER_PRODUCE_TOTAL_TIME_MS_MAX").strategy
+        is ValueStrategy.AVG
+    )
+    # Only CPU_USAGE is the CPU-model prediction target.
+    assert COMMON_METRIC_DEF.metric_info("CPU_USAGE").to_predict
+    assert not COMMON_METRIC_DEF.metric_info("DISK_USAGE").to_predict
+
+
+def test_resource_groups():
+    groups = resource_to_metric_ids(COMMON_METRIC_DEF)
+    assert groups[Resource.CPU] == [COMMON_METRIC_DEF.metric_info("CPU_USAGE").id]
+    assert groups[Resource.DISK] == [COMMON_METRIC_DEF.metric_info("DISK_USAGE").id]
+    assert len(groups[Resource.NW_IN]) == 2   # leader bytes in + replication bytes in
+    assert len(groups[Resource.NW_OUT]) == 2
+
+
+def test_resource_properties():
+    assert Resource.CPU.is_host_resource
+    assert not Resource.DISK.is_host_resource
+    assert Resource.DISK.is_broker_resource
+    assert Resource.CPU.epsilon(1e6, 1e6) > 0
