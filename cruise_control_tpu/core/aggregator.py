@@ -238,12 +238,17 @@ class MetricSampleAggregator(Generic[E]):
     # -- aggregation --------------------------------------------------------
 
     def available_window_ids(self) -> List[int]:
-        """Stable (non-current) windows currently retained, oldest→newest."""
+        """Stable (non-current) windows in retention, oldest→newest.
+
+        The range is contiguous: windows that received no samples (never stamped
+        into the ring) are still listed — they aggregate as empty, so adjacency in
+        the output equals adjacency in time and completeness counts the gaps.
+        """
         with self._lock:
             if self._current_window < 0:
                 return []
             lo = max(0, self._current_window - self.num_windows)
-            return [w for w in range(lo, self._current_window) if self._win_id[w % self._ring] == w]
+            return list(range(lo, self._current_window))
 
     def aggregate(
         self,
@@ -270,6 +275,9 @@ class MetricSampleAggregator(Generic[E]):
             ents = list(entities) if entities is not None else list(self._entities)
             rows = np.array([self._entity_index.get(e, -1) for e in ents], np.int64)
             slots = np.array([w % self._ring for w in win_ids], np.int64)
+            # A slot only holds data for window w if it was stamped with w; windows
+            # skipped during rolling (or never written) must aggregate as empty.
+            slot_live = self._win_id[slots] == np.array(win_ids)
 
             m = self.metric_def.size()
             n_e, n_w = len(ents), len(win_ids)
@@ -279,6 +287,8 @@ class MetricSampleAggregator(Generic[E]):
             if present.any():
                 acc[present] = self._acc[rows[present]][:, slots, :]
                 count[present] = self._count[rows[present]][:, slots]
+            acc[:, ~slot_live, :] = 0.0
+            count[:, ~slot_live] = 0
 
             values, extrap = self._extrapolate(acc, count)
             completeness = self._completeness(ents, win_ids, extrap, options)

@@ -255,16 +255,10 @@ class Config:
     def unknown(self) -> List[str]:
         return [k for k in self.originals if k not in self._values]
 
-    def get_configured_instance(self, name: str, expected: type, extra: Optional[Mapping[str, Any]] = None) -> Any:
-        """Instantiate a plugin class named by config key ``name``.
-
-        The instance's ``configure(config_dict)`` method, if present, is called with
-        the full original config plus ``extra`` — mirroring the reference's
-        ``getConfiguredInstance`` + ``CruiseControlConfigurable.configure`` contract.
-        """
-        cls = resolve_class(self.get(name))
+    def _instantiate(self, key_name: str, spec: Any, expected: type, extra: Optional[Mapping[str, Any]]) -> Any:
+        cls = resolve_class(spec)
         if not issubclass(cls, expected):
-            raise ConfigException(f"{name}: {cls} is not a subclass of {expected}")
+            raise ConfigException(f"{key_name}: {cls} is not a subclass of {expected}")
         instance = cls()
         if hasattr(instance, "configure"):
             merged = dict(self.originals)
@@ -272,20 +266,18 @@ class Config:
             instance.configure(merged)
         return instance
 
+    def get_configured_instance(self, name: str, expected: type, extra: Optional[Mapping[str, Any]] = None) -> Any:
+        """Instantiate a plugin class named by config key ``name``.
+
+        The instance's ``configure(config_dict)`` method, if present, is called with
+        the full original config plus ``extra`` — mirroring the reference's
+        ``getConfiguredInstance`` + ``CruiseControlConfigurable.configure`` contract.
+        """
+        return self._instantiate(name, self.get(name), expected, extra)
+
     def get_configured_instances(self, name: str, expected: type, extra: Optional[Mapping[str, Any]] = None) -> List[Any]:
         specs: Sequence[Any] = self.get(name) or []
-        out = []
-        for spec in specs:
-            cls = resolve_class(spec)
-            if not issubclass(cls, expected):
-                raise ConfigException(f"{name}: {cls} is not a subclass of {expected}")
-            instance = cls()
-            if hasattr(instance, "configure"):
-                merged = dict(self.originals)
-                merged.update(extra or {})
-                instance.configure(merged)
-            out.append(instance)
-        return out
+        return [self._instantiate(name, spec, expected, extra) for spec in specs]
 
     def to_dict(self, redact: bool = True) -> Dict[str, Any]:
         out = {}

@@ -1,0 +1,341 @@
+"""Dense array representation of the cluster model — the TPU-native ClusterModel.
+
+Counterpart of ``model/ClusterModel.java:48`` (racks→hosts→brokers→disks→replicas with
+per-replica windowed ``Load``), redesigned array-first: the whole topology flattens into
+fixed-shape integer/float tensors so every analyzer operation is a gather / segment-sum /
+scatter that XLA tiles onto the MXU/VPU, and the solver state threads functionally
+through ``jit``/``lax`` control flow.
+
+Key design decisions (vs the reference's mutable object graph):
+
+* **Leadership is an index array, not a flag.** ``partition_leader[P]`` holds the
+  replica index of each partition's leader; ``is_leader`` is a derived gather-compare.
+  There is no way to have zero or two leaders — the invariant the reference maintains
+  imperatively (``Partition.relocateLeadership``) holds by construction.
+
+* **Leadership load transfer is algebra, not mutation.** Each replica stores its
+  follower-equivalent ``base_load[R, 4]``; each partition stores a static
+  ``leadership_delta[P, 4]`` = (cpu_leader − cpu_follower_est, 0, nw_out_leader, 0),
+  computed at ingest from the then-leader's measured load via the ModelUtils heuristic.
+  Effective replica load is ``base + is_leader · delta`` — so ``relocateLeadership``
+  (ClusterModel.java:409: "transfers the whole outbound network and a fraction of CPU
+  load") is reproduced exactly by changing one index, with no load bookkeeping to
+  corrupt.
+
+* **Moves are index updates.** ``relocateReplica`` (ClusterModel.java:380) is a scatter
+  into ``replica_broker``; all broker loads are recomputed as segment sums on demand
+  (fused by XLA), instead of the reference's O(1)-incremental-but-sequential load edits.
+
+Axes: R = replicas (padded, ``replica_valid`` masks tails), P = partitions, B = brokers,
+T = topics, D = disks (JBOD logdirs; D may be 0).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cruise_control_tpu.core.resources import (
+    NUM_DERIVED_RESOURCES,
+    NUM_RESOURCES,
+    DerivedResource,
+    Resource,
+)
+
+
+@struct.dataclass
+class ClusterArrays:
+    """Immutable flattened cluster state (a jax pytree)."""
+
+    # replica axis
+    replica_partition: jax.Array   # i32[R]
+    replica_broker: jax.Array      # i32[R]
+    replica_disk: jax.Array        # i32[R], -1 when not JBOD
+    replica_valid: jax.Array       # bool[R] padding / existence mask
+    base_load: jax.Array           # f32[R, 4] follower-equivalent load
+    original_broker: jax.Array     # i32[R] broker at snapshot time (immigrant tracking)
+
+    # partition axis
+    partition_topic: jax.Array     # i32[P]
+    partition_leader: jax.Array    # i32[P] replica index of current leader
+    leadership_delta: jax.Array    # f32[P, 4] load that travels with leadership
+
+    # broker axis
+    broker_rack: jax.Array         # i32[B]
+    broker_host: jax.Array         # i32[B]
+    broker_capacity: jax.Array     # f32[B, 4]
+    broker_alive: jax.Array        # bool[B]
+    broker_new: jax.Array          # bool[B]
+    broker_demoted: jax.Array      # bool[B]
+    broker_offline_replicas: jax.Array  # bool[R] replica currently offline (dead broker/disk)
+
+    # disk axis (JBOD; zero-length arrays when not configured)
+    disk_broker: jax.Array         # i32[D]
+    disk_capacity: jax.Array       # f32[D]
+    disk_alive: jax.Array          # bool[D]
+
+    # static metadata (python ints — not traced)
+    num_racks: int = struct.field(pytree_node=False, default=0)
+    num_topics: int = struct.field(pytree_node=False, default=0)
+    num_hosts: int = struct.field(pytree_node=False, default=0)
+
+    # -- derived shapes ------------------------------------------------------
+
+    @property
+    def num_replicas(self) -> int:
+        return self.replica_partition.shape[0]
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partition_topic.shape[0]
+
+    @property
+    def num_brokers(self) -> int:
+        return self.broker_rack.shape[0]
+
+    @property
+    def num_disks(self) -> int:
+        return self.disk_broker.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# Pure queries (all jit-friendly).
+# ---------------------------------------------------------------------------
+
+
+def is_leader(state: ClusterArrays) -> jax.Array:
+    """bool[R]: whether each replica currently leads its partition."""
+    return (
+        state.partition_leader[state.replica_partition]
+        == jnp.arange(state.num_replicas, dtype=jnp.int32)
+    ) & state.replica_valid
+
+
+def effective_load(state: ClusterArrays) -> jax.Array:
+    """f32[R, 4]: per-replica load given current leadership."""
+    lead = is_leader(state)
+    delta = state.leadership_delta[state.replica_partition]
+    load = state.base_load + jnp.where(lead[:, None], delta, 0.0)
+    return jnp.where(state.replica_valid[:, None], load, 0.0)
+
+
+def broker_load(state: ClusterArrays) -> jax.Array:
+    """f32[B, 4]: total utilization per broker (ClusterModel per-broker Load)."""
+    return jax.ops.segment_sum(
+        effective_load(state), state.replica_broker, num_segments=state.num_brokers
+    )
+
+
+def host_load(state: ClusterArrays) -> jax.Array:
+    """f32[H, 4]: total utilization per host (host-level resources CPU/NW)."""
+    per_broker = broker_load(state)
+    return jax.ops.segment_sum(per_broker, state.broker_host, num_segments=state.num_hosts)
+
+
+def broker_replica_counts(state: ClusterArrays) -> jax.Array:
+    """i32[B]: replicas hosted per broker."""
+    return jax.ops.segment_sum(
+        state.replica_valid.astype(jnp.int32),
+        state.replica_broker,
+        num_segments=state.num_brokers,
+    )
+
+
+def broker_leader_counts(state: ClusterArrays) -> jax.Array:
+    """i32[B]: leader replicas per broker."""
+    return jax.ops.segment_sum(
+        is_leader(state).astype(jnp.int32),
+        state.replica_broker,
+        num_segments=state.num_brokers,
+    )
+
+
+def potential_nw_out(state: ClusterArrays) -> jax.Array:
+    """f32[B]: outbound network if every hosted replica became leader.
+
+    ClusterModel's ``_potentialLeadershipLoadByBrokerId`` (ClusterModel.java:394):
+    each replica contributes its partition-leader's NW_OUT.
+    """
+    leader_nw_out = (
+        state.base_load[:, Resource.NW_OUT]
+        + state.leadership_delta[state.replica_partition, Resource.NW_OUT]
+    )
+    leader_nw_out = jnp.where(state.replica_valid, leader_nw_out, 0.0)
+    return jax.ops.segment_sum(
+        leader_nw_out, state.replica_broker, num_segments=state.num_brokers
+    )
+
+
+def disk_load(state: ClusterArrays) -> jax.Array:
+    """f32[D]: disk-space utilization per JBOD logdir."""
+    if state.num_disks == 0:
+        return jnp.zeros((0,), jnp.float32)
+    du = jnp.where(state.replica_valid, state.base_load[:, Resource.DISK], 0.0)
+    disk_idx = jnp.where(state.replica_disk >= 0, state.replica_disk, 0)
+    du = jnp.where(state.replica_disk >= 0, du, 0.0)
+    return jax.ops.segment_sum(du, disk_idx, num_segments=state.num_disks)
+
+
+def utilization_matrix(state: ClusterArrays) -> jax.Array:
+    """f32[8, B]: the derived-resource utilization matrix.
+
+    Mirrors ``ClusterModel.utilizationMatrix()`` (ClusterModel.java:1332) /
+    ``RawAndDerivedResource.java``: rows DISK, CPU, LEADER_NW_IN, FOLLOWER_NW_IN,
+    NW_OUT, PNW_OUT, LEADER_REPLICAS, REPLICAS — the natural dense seed for on-device
+    analytics and the PARTITION_LOAD/LOAD endpoints.
+    """
+    eff = effective_load(state)
+    lead = is_leader(state)
+    B = state.num_brokers
+    seg = lambda x: jax.ops.segment_sum(x, state.replica_broker, num_segments=B)
+
+    nw_in = eff[:, Resource.NW_IN]
+    rows = jnp.zeros((NUM_DERIVED_RESOURCES, B), jnp.float32)
+    rows = rows.at[DerivedResource.DISK].set(seg(eff[:, Resource.DISK]))
+    rows = rows.at[DerivedResource.CPU].set(seg(eff[:, Resource.CPU]))
+    rows = rows.at[DerivedResource.LEADER_NW_IN].set(seg(jnp.where(lead, nw_in, 0.0)))
+    rows = rows.at[DerivedResource.FOLLOWER_NW_IN].set(seg(jnp.where(lead, 0.0, nw_in)))
+    rows = rows.at[DerivedResource.NW_OUT].set(seg(eff[:, Resource.NW_OUT]))
+    rows = rows.at[DerivedResource.PNW_OUT].set(potential_nw_out(state))
+    rows = rows.at[DerivedResource.LEADER_REPLICAS].set(
+        broker_leader_counts(state).astype(jnp.float32)
+    )
+    rows = rows.at[DerivedResource.REPLICAS].set(
+        broker_replica_counts(state).astype(jnp.float32)
+    )
+    return rows
+
+
+def topic_replica_counts_by_broker(state: ClusterArrays) -> jax.Array:
+    """i32[B, T]: replicas of each topic on each broker (TopicReplicaDistributionGoal)."""
+    topic = state.partition_topic[state.replica_partition]
+    flat = state.replica_broker * state.num_topics + topic
+    counts = jax.ops.segment_sum(
+        state.replica_valid.astype(jnp.int32),
+        flat,
+        num_segments=state.num_brokers * state.num_topics,
+    )
+    return counts.reshape(state.num_brokers, state.num_topics)
+
+
+def replicas_per_rack_per_partition(state: ClusterArrays) -> jax.Array:
+    """i32[P, num_racks]: replica count of each partition in each rack (RackAwareGoal)."""
+    rack = state.broker_rack[state.replica_broker]
+    flat = state.replica_partition * state.num_racks + rack
+    counts = jax.ops.segment_sum(
+        state.replica_valid.astype(jnp.int32),
+        flat,
+        num_segments=state.num_partitions * state.num_racks,
+    )
+    return counts.reshape(state.num_partitions, state.num_racks)
+
+
+# ---------------------------------------------------------------------------
+# Pure mutations (scatter updates returning a new state).
+# ---------------------------------------------------------------------------
+
+
+def relocate_replicas(
+    state: ClusterArrays,
+    replica_idx: jax.Array,
+    dst_broker: jax.Array,
+    dst_disk: Optional[jax.Array] = None,
+) -> ClusterArrays:
+    """Move replicas to destination brokers (batched relocateReplica, :380).
+
+    ``replica_idx`` i32[K], ``dst_broker`` i32[K].  Entries with ``replica_idx < 0``
+    are no-ops (enables fixed-shape batched application under jit).  A moved
+    replica's logdir assignment does not travel with it: ``replica_disk`` is reset
+    to -1 (unassigned on the destination) unless ``dst_disk`` names target disks.
+    """
+    replica_idx = jnp.asarray(replica_idx)
+    dst_broker = jnp.asarray(dst_broker)
+    ok = replica_idx >= 0
+    safe_idx = jnp.where(ok, replica_idx, 0)
+    new_broker = jnp.where(ok, dst_broker, state.replica_broker[safe_idx])
+    target_disk = jnp.asarray(dst_disk) if dst_disk is not None else jnp.full_like(safe_idx, -1)
+    new_disk = jnp.where(ok, target_disk, state.replica_disk[safe_idx])
+    return state.replace(
+        replica_broker=state.replica_broker.at[safe_idx].set(new_broker),
+        replica_disk=state.replica_disk.at[safe_idx].set(new_disk),
+    )
+
+
+def relocate_leadership(
+    state: ClusterArrays, partition_idx: jax.Array, dst_replica: jax.Array
+) -> ClusterArrays:
+    """Transfer partition leadership to a destination replica (batched, :409).
+
+    Entries with ``partition_idx < 0`` are no-ops.  The load transfer is implicit in
+    the ``base + is_leader·delta`` formulation.
+    """
+    partition_idx = jnp.asarray(partition_idx)
+    dst_replica = jnp.asarray(dst_replica)
+    ok = partition_idx >= 0
+    safe_p = jnp.where(ok, partition_idx, 0)
+    new_leader = jnp.where(ok, dst_replica, state.partition_leader[safe_p])
+    return state.replace(partition_leader=state.partition_leader.at[safe_p].set(new_leader))
+
+
+def swap_replicas(
+    state: ClusterArrays, replica_a: jax.Array, replica_b: jax.Array
+) -> ClusterArrays:
+    """Exchange the brokers of two replicas (INTER_BROKER_REPLICA_SWAP)."""
+    replica_a = jnp.asarray(replica_a)
+    replica_b = jnp.asarray(replica_b)
+    ok = (replica_a >= 0) & (replica_b >= 0)
+    sa = jnp.where(ok, replica_a, 0)
+    sb = jnp.where(ok, replica_b, 0)
+    ba = state.replica_broker[sa]
+    bb = state.replica_broker[sb]
+    brokers = state.replica_broker.at[sa].set(jnp.where(ok, bb, ba))
+    brokers = brokers.at[sb].set(jnp.where(ok, ba, bb))
+    # logdir placement does not survive a cross-broker move (see relocate_replicas)
+    disks = state.replica_disk.at[sa].set(jnp.where(ok, -1, state.replica_disk[sa]))
+    disks = disks.at[sb].set(jnp.where(ok, -1, disks[sb]))
+    return state.replace(replica_broker=brokers, replica_disk=disks)
+
+
+def set_broker_state(
+    state: ClusterArrays,
+    broker_id: int,
+    alive: Optional[bool] = None,
+    new: Optional[bool] = None,
+    demoted: Optional[bool] = None,
+) -> ClusterArrays:
+    """Update one broker's lifecycle flags (ClusterModel.setBrokerState, :297)."""
+    out = state
+    if alive is not None:
+        out = out.replace(broker_alive=out.broker_alive.at[broker_id].set(alive))
+        offline = out.replica_offline_mask()
+        out = out.replace(broker_offline_replicas=offline)
+    if new is not None:
+        out = out.replace(broker_new=out.broker_new.at[broker_id].set(new))
+    if demoted is not None:
+        out = out.replace(broker_demoted=out.broker_demoted.at[broker_id].set(demoted))
+    return out
+
+
+def _replica_offline_mask(state: ClusterArrays) -> jax.Array:
+    dead_broker = ~state.broker_alive[state.replica_broker]
+    if state.num_disks > 0:
+        on_disk = state.replica_disk >= 0
+        disk_idx = jnp.where(on_disk, state.replica_disk, 0)
+        dead_disk = on_disk & ~state.disk_alive[disk_idx]
+    else:
+        dead_disk = jnp.zeros_like(dead_broker)
+    return (dead_broker | dead_disk) & state.replica_valid
+
+
+# Exposed as a method-style helper on the dataclass.
+ClusterArrays.replica_offline_mask = _replica_offline_mask
+
+
+def self_satisfied_state_hash(state: ClusterArrays) -> jax.Array:
+    """Cheap content hash of the placement, for convergence detection."""
+    h1 = jnp.sum(state.replica_broker.astype(jnp.int64) * 2654435761)
+    h2 = jnp.sum(state.partition_leader.astype(jnp.int64) * 40503)
+    return h1 ^ h2

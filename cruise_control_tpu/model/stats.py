@@ -1,0 +1,92 @@
+"""Cluster-level aggregate statistics.
+
+Counterpart of ``model/ClusterModelStats.java:30-47`` (+ ``ClusterModelStatsValue``):
+per-resource utilization avg/max/min/std over alive brokers, replica/leader/topic-replica
+count dispersion, and balanced-broker counts — the numbers goals use to verify they
+did not regress (``AbstractGoal.java:120-123``) and that surface in the STATS section
+of responses.
+
+Everything is a jit-friendly reduction over :class:`ClusterArrays`; a stats dict is a
+pytree of scalars, so goals can diff two of them on device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.core.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model import arrays as A
+from cruise_control_tpu.model.arrays import ClusterArrays
+
+
+def _masked_stats(values: jax.Array, mask: jax.Array) -> Dict[str, jax.Array]:
+    """avg/max/min/std of ``values`` over ``mask`` (per trailing axes broadcast)."""
+    n = jnp.maximum(mask.sum(), 1)
+    big = jnp.asarray(jnp.finfo(jnp.float32).max)
+    masked = jnp.where(mask, values, 0.0)
+    avg = masked.sum(axis=0) / n
+    mx = jnp.where(mask, values, -big).max(axis=0)
+    mn = jnp.where(mask, values, big).min(axis=0)
+    var = jnp.where(mask, (values - avg) ** 2, 0.0).sum(axis=0) / n
+    return {"avg": avg, "max": mx, "min": mn, "std": jnp.sqrt(var)}
+
+
+def cluster_model_stats(
+    state: ClusterArrays, balance_percentage: jax.Array | None = None
+) -> Dict[str, jax.Array]:
+    """Aggregate stats over alive brokers (ClusterModel.getClusterStats, :137).
+
+    Returns a flat dict pytree:
+
+    * ``util_{avg,max,min,std}``: f32[4] absolute utilization per resource
+    * ``cap_util_{...}``: f32[4] utilization as a fraction of capacity
+    * ``replicas_{...}``, ``leaders_{...}``: f32 count dispersion
+    * ``num_balanced_by_resource``: i32[4] brokers within the balance band
+      (``_numBalancedBrokersByResource``) when ``balance_percentage`` given
+    * ``num_alive_brokers``, ``total_replicas``
+    """
+    alive = state.broker_alive
+    mask2 = alive[:, None]
+
+    load = A.broker_load(state)                       # [B, 4]
+    cap = jnp.maximum(state.broker_capacity, 1e-9)
+    cap_util = load / cap
+
+    out: Dict[str, jax.Array] = {}
+    for key, val, m in (
+        ("util", load, mask2),
+        ("cap_util", cap_util, mask2),
+    ):
+        s = _masked_stats(val, m)
+        for stat_name, v in s.items():
+            out[f"{key}_{stat_name}"] = v
+
+    replicas = A.broker_replica_counts(state).astype(jnp.float32)
+    leaders = A.broker_leader_counts(state).astype(jnp.float32)
+    for key, val in (("replicas", replicas), ("leaders", leaders)):
+        s = _masked_stats(val, alive)
+        for stat_name, v in s.items():
+            out[f"{key}_{stat_name}"] = v
+
+    if balance_percentage is not None:
+        # A broker is balanced for resource r when its utilization lies within
+        # [avg*(2-pct), avg*pct] (ClusterModelStats balanced-broker accounting;
+        # the reference's lower threshold is avg*(2-pct), not avg/pct).
+        avg = out["util_avg"][None, :]
+        pct = jnp.asarray(balance_percentage)
+        within = (load <= avg * pct) & (load >= avg * (2.0 - pct))
+        out["num_balanced_by_resource"] = (within & mask2).sum(axis=0)
+
+    out["num_alive_brokers"] = alive.sum()
+    out["total_replicas"] = state.replica_valid.sum()
+    return out
+
+
+def utilization_std(state: ClusterArrays, resource: Resource) -> jax.Array:
+    """Std-dev of one resource's utilization over alive brokers — the quantity
+    distribution-goal comparators guard (ClusterModelStatsComparator semantics)."""
+    load = A.broker_load(state)[:, resource]
+    return _masked_stats(load, state.broker_alive)["std"]

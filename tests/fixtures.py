@@ -1,0 +1,117 @@
+"""Deterministic test clusters.
+
+Port of the *behavioral fixtures* in the reference's test tree
+(``cruise-control/src/test/java/.../common/DeterministicCluster.java:32`` and
+``TestConstants.java``): tiny explicit clusters with hand-set loads, used for exact
+assertions on model math and goal outcomes.  Loads are [CPU, NW_IN, NW_OUT, DISK].
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional
+
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.model.cluster import ClusterModel
+
+# TestConstants.java:36-38,105-107
+TYPICAL_CPU_CAPACITY = 100.0
+LARGE_BROKER_CAPACITY = 300000.0
+MEDIUM_BROKER_CAPACITY = 200000.0
+SMALL_BROKER_CAPACITY = 10.0
+
+BROKER_CAPACITY: Dict[Resource, float] = {
+    Resource.CPU: TYPICAL_CPU_CAPACITY,
+    Resource.DISK: LARGE_BROKER_CAPACITY,
+    Resource.NW_IN: LARGE_BROKER_CAPACITY,
+    Resource.NW_OUT: MEDIUM_BROKER_CAPACITY,
+}
+
+# DeterministicCluster.java:48-60
+RACK_BY_BROKER = {0: "0", 1: "0", 2: "1"}
+RACK_BY_BROKER2 = {0: "0", 1: "1", 2: "1"}
+RACK_BY_BROKER4 = {0: "0", 1: "1", 2: "2", 3: "0", 4: "1", 5: "2"}
+
+T1, T2 = "T1", "T2"
+
+
+def load(cpu: float, nw_in: float, nw_out: float, disk: float):
+    return [cpu, nw_in, nw_out, disk]
+
+
+def homogeneous_cluster(
+    rack_by_broker: Mapping[int, str],
+    capacity: Optional[Mapping[Resource, float]] = None,
+    logdirs: Optional[Mapping[str, float]] = None,
+) -> ClusterModel:
+    """All brokers share one capacity spec (DeterministicCluster.getHomogeneousCluster)."""
+    cluster = ClusterModel()
+    for broker_id, rack in sorted(rack_by_broker.items()):
+        cluster.create_broker(rack, broker_id, capacity or BROKER_CAPACITY, logdirs=logdirs)
+    return cluster
+
+
+def unbalanced() -> ClusterModel:
+    """Two racks, three brokers, two 1-replica partitions both on broker 0
+    (DeterministicCluster.unbalanced, :200)."""
+    cluster = homogeneous_cluster(RACK_BY_BROKER)
+    half = load(
+        TYPICAL_CPU_CAPACITY / 2,
+        LARGE_BROKER_CAPACITY / 2,
+        MEDIUM_BROKER_CAPACITY / 2,
+        LARGE_BROKER_CAPACITY / 2,
+    )
+    for topic in (T1, T2):
+        cluster.create_replica(0, (topic, 0), 0, True)
+        cluster.set_replica_load(0, (topic, 0), half)
+    return cluster
+
+
+def unbalanced2() -> ClusterModel:
+    """unbalanced() plus four more 1-replica partitions, 3 on broker 0, 1 on broker 1
+    (DeterministicCluster.unbalanced2)."""
+    cluster = unbalanced()
+    half = load(
+        TYPICAL_CPU_CAPACITY / 2,
+        LARGE_BROKER_CAPACITY / 2,
+        MEDIUM_BROKER_CAPACITY / 2,
+        LARGE_BROKER_CAPACITY / 2,
+    )
+    placements = [(1, (T1, 1)), (0, (T2, 1)), (0, (T1, 2)), (0, (T2, 2))]
+    for broker, tp in placements:
+        cluster.create_replica(broker, tp, 0, True)
+        cluster.set_replica_load(broker, tp, half)
+    return cluster
+
+
+def unbalanced_with_a_follower() -> ClusterModel:
+    """unbalanced() with a follower of T1-0 on broker 2
+    (DeterministicCluster.unbalancedWithAFollower)."""
+    cluster = unbalanced()
+    cluster.create_replica(2, (T1, 0), 1, False)
+    cluster.set_replica_load(
+        2,
+        (T1, 0),
+        load(TYPICAL_CPU_CAPACITY / 8, LARGE_BROKER_CAPACITY / 2, 0.0, LARGE_BROKER_CAPACITY / 2),
+    )
+    return cluster
+
+
+def rack_aware_satisfiable() -> ClusterModel:
+    """Two racks, three brokers, one partition with replicas on brokers 0 and 1 —
+    both in rack '0', so rack-awareness is violated but fixable by moving one replica
+    to rack '1' (DeterministicCluster.rackAwareSatisfiable, :227)."""
+    cluster = homogeneous_cluster(RACK_BY_BROKER)
+    cluster.create_replica(0, (T1, 0), 0, True)
+    cluster.create_replica(1, (T1, 0), 1, False)
+    cluster.set_replica_load(0, (T1, 0), load(40.0, 100.0, 130.0, 75.0))
+    cluster.set_replica_load(1, (T1, 0), load(5.0, 100.0, 0.0, 75.0))
+    return cluster
+
+
+def rack_aware_unsatisfiable() -> ClusterModel:
+    """rack_aware_satisfiable() plus a third replica: 3 replicas, only 2 racks —
+    rack-awareness cannot be satisfied (DeterministicCluster.rackAwareUnsatisfiable)."""
+    cluster = rack_aware_satisfiable()
+    cluster.create_replica(2, (T1, 0), 2, False)
+    cluster.set_replica_load(2, (T1, 0), load(5.0, 100.0, 0.0, 75.0))
+    return cluster

@@ -128,6 +128,22 @@ def test_extrapolation_avg_adjacent():
     assert vae.values[i, w, 0] == pytest.approx(15.5)
 
 
+def test_adjacent_means_adjacent_in_time_not_position():
+    # Samples land only in window 3; retention covers [2..9].  Window 4 may borrow
+    # (truly adjacent) but windows 6+ must NOT be filled from window 3.
+    agg = _agg(num_windows=8, min_samples=1)
+    fill_window(agg, "p0", 3, n=1, base=10.0)
+    agg.add_sample("p0", 10 * WINDOW_MS, [0.0] * 3)  # current window 10
+    vae, _ = agg.aggregate(options=AggregationOptions(include_invalid_entities=True))
+    assert vae.window_ids == list(range(2, 10))
+    i = vae.entity_index("p0")
+    w = {wid: k for k, wid in enumerate(vae.window_ids)}
+    assert vae.extrapolations[i, w[2]] == Extrapolation.AVG_ADJACENT
+    assert vae.extrapolations[i, w[4]] == Extrapolation.AVG_ADJACENT
+    for far in (5, 6, 7, 8, 9):
+        assert vae.extrapolations[i, w[far]] == Extrapolation.NO_VALID_EXTRAPOLATION
+
+
 def test_no_valid_extrapolation_marks_entity_invalid():
     agg = _agg(num_windows=4, min_samples=2)
     fill_window(agg, "good", 0)

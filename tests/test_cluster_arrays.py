@@ -1,0 +1,231 @@
+"""Model-layer tests: array snapshots, load math, mutations, stats, diff.
+
+Reference behavior: ClusterModelTest / DeterministicClusterTest model assertions.
+"""
+
+import numpy as np
+import pytest
+
+import fixtures
+from cruise_control_tpu.core.resources import DerivedResource, Resource
+from cruise_control_tpu.model import arrays as A
+from cruise_control_tpu.model import stats as S
+from cruise_control_tpu.model.cluster import BrokerState
+from cruise_control_tpu.model.model_utils import follower_cpu_from_leader_load
+from cruise_control_tpu.analyzer.proposals import diff
+
+
+def test_unbalanced_broker_loads():
+    state, maps = fixtures.unbalanced().to_arrays()
+    load = np.asarray(A.broker_load(state))
+    # both partitions lead on broker 0 with load (50, 150000, 100000, 150000)
+    np.testing.assert_allclose(load[0], [100.0, 300000.0, 200000.0, 300000.0], rtol=1e-5)
+    np.testing.assert_allclose(load[1], 0.0)
+    np.testing.assert_allclose(load[2], 0.0)
+    assert maps.broker_ids == [0, 1, 2]
+    assert state.num_racks == 2
+
+
+def test_leadership_index_derivation():
+    state, maps = fixtures.rack_aware_satisfiable().to_arrays()
+    lead = np.asarray(A.is_leader(state))
+    assert lead.sum() == 1
+    leader_row = int(np.asarray(state.partition_leader)[0])
+    assert np.asarray(state.replica_broker)[leader_row] == maps.broker_index[0]
+
+
+def test_effective_load_reconstructs_measured():
+    """base + is_leader*delta must reproduce the measured loads exactly."""
+    state, maps = fixtures.rack_aware_satisfiable().to_arrays()
+    eff = np.asarray(A.effective_load(state))
+    by_broker = {maps.broker_index[b]: b for b in maps.broker_ids}
+    rb = np.asarray(state.replica_broker)
+    for row in range(state.num_replicas):
+        broker_id = by_broker[rb[row]]
+        if broker_id == 0:
+            np.testing.assert_allclose(eff[row], [40.0, 100.0, 130.0, 75.0], rtol=1e-5)
+        elif broker_id == 1:
+            np.testing.assert_allclose(eff[row], [5.0, 100.0, 0.0, 75.0], rtol=1e-5)
+
+
+def test_leadership_relocation_transfers_nwout_and_cpu_fraction():
+    """relocateLeadership semantics (ClusterModel.java:409): whole NW_OUT + CPU
+    fraction move to the destination."""
+    state, maps = fixtures.rack_aware_satisfiable().to_arrays()
+    rb = np.asarray(state.replica_broker)
+    follower_row = int(np.nonzero(rb == maps.broker_index[1])[0][0])
+    moved = A.relocate_leadership(state, np.array([0]), np.array([follower_row]))
+
+    load = np.asarray(A.broker_load(moved))
+    follower_cpu_est = follower_cpu_from_leader_load(100.0, 130.0, 40.0)
+    delta_cpu = 40.0 - follower_cpu_est
+    # old leader keeps follower-equivalent load
+    np.testing.assert_allclose(load[0], [follower_cpu_est, 100.0, 0.0, 75.0], rtol=1e-5)
+    # new leader gains full NW_OUT + CPU delta
+    np.testing.assert_allclose(load[1], [5.0 + delta_cpu, 100.0, 130.0, 75.0], rtol=1e-5)
+    # NW_IN and DISK untouched by leadership moves
+    np.testing.assert_allclose(load[:, Resource.DISK].sum(), 150.0, rtol=1e-5)
+
+
+def test_relocate_replicas():
+    state, maps = fixtures.unbalanced().to_arrays()
+    moved = A.relocate_replicas(state, np.array([0]), np.array([maps.broker_index[2]]))
+    load = np.asarray(A.broker_load(moved))
+    np.testing.assert_allclose(load[0], [50.0, 150000.0, 100000.0, 150000.0], rtol=1e-5)
+    np.testing.assert_allclose(load[2], [50.0, 150000.0, 100000.0, 150000.0], rtol=1e-5)
+    # negative index is a no-op
+    same = A.relocate_replicas(state, np.array([-1]), np.array([1]))
+    np.testing.assert_array_equal(
+        np.asarray(same.replica_broker), np.asarray(state.replica_broker)
+    )
+
+
+def test_swap_replicas():
+    state, maps = fixtures.unbalanced_with_a_follower().to_arrays()
+    rb0 = np.asarray(state.replica_broker).copy()
+    rows = np.nonzero(rb0 != rb0[0])[0]
+    other = int(rows[0])
+    swapped = A.swap_replicas(state, np.array([0]), np.array([other]))
+    rb1 = np.asarray(swapped.replica_broker)
+    assert rb1[0] == rb0[other] and rb1[other] == rb0[0]
+
+
+def test_potential_nw_out():
+    state, maps = fixtures.rack_aware_satisfiable().to_arrays()
+    pnw = np.asarray(A.potential_nw_out(state))
+    # every replica contributes its partition-leader's NW_OUT (130)
+    np.testing.assert_allclose(pnw[maps.broker_index[0]], 130.0, rtol=1e-5)
+    np.testing.assert_allclose(pnw[maps.broker_index[1]], 130.0, rtol=1e-5)
+    np.testing.assert_allclose(pnw[maps.broker_index[2]], 0.0)
+
+
+def test_utilization_matrix_rows():
+    state, maps = fixtures.rack_aware_satisfiable().to_arrays()
+    m = np.asarray(A.utilization_matrix(state))
+    b0, b1 = maps.broker_index[0], maps.broker_index[1]
+    assert m[DerivedResource.CPU, b0] == pytest.approx(40.0, rel=1e-5)
+    assert m[DerivedResource.LEADER_NW_IN, b0] == pytest.approx(100.0, rel=1e-5)
+    assert m[DerivedResource.FOLLOWER_NW_IN, b1] == pytest.approx(100.0, rel=1e-5)
+    assert m[DerivedResource.NW_OUT, b0] == pytest.approx(130.0, rel=1e-5)
+    assert m[DerivedResource.PNW_OUT, b1] == pytest.approx(130.0, rel=1e-5)
+    assert m[DerivedResource.LEADER_REPLICAS, b0] == 1.0
+    assert m[DerivedResource.REPLICAS].sum() == 2.0
+
+
+def test_rack_partition_counts():
+    state, _ = fixtures.rack_aware_satisfiable().to_arrays()
+    counts = np.asarray(A.replicas_per_rack_per_partition(state))
+    # both replicas in rack '0' -> rack-aware violation visible as count 2
+    assert counts.tolist() == [[2, 0]]
+
+
+def test_dead_broker_offline_replicas():
+    cluster = fixtures.unbalanced()
+    cluster.set_broker_state(1, BrokerState.DEAD)
+    state, maps = cluster.to_arrays()
+    assert not bool(np.asarray(state.broker_alive)[maps.broker_index[1]])
+    # no replicas on broker 1 in this fixture; mark broker 0 dead via array op
+    state2 = A.set_broker_state(state, maps.broker_index[0], alive=False)
+    offline = np.asarray(state2.broker_offline_replicas)
+    assert offline.sum() == 2  # both replicas live on broker 0
+
+
+def test_jbod_disks_and_disk_death():
+    logdirs = {"/d0": 150000.0, "/d1": 150000.0}
+    cluster = fixtures.homogeneous_cluster(fixtures.RACK_BY_BROKER, logdirs=logdirs)
+    cluster.create_replica(0, ("T1", 0), 0, True, logdir="/d0")
+    cluster.set_replica_load(0, ("T1", 0), fixtures.load(10.0, 5.0, 5.0, 1000.0))
+    cluster.create_replica(0, ("T1", 1), 0, True, logdir="/d1")
+    cluster.set_replica_load(0, ("T1", 1), fixtures.load(10.0, 5.0, 5.0, 2000.0))
+    state, maps = cluster.to_arrays()
+    assert state.num_disks == 6
+    dl = np.asarray(A.disk_load(state))
+    assert dl[maps.disk_index[(0, "/d0")]] == pytest.approx(1000.0)
+    assert dl[maps.disk_index[(0, "/d1")]] == pytest.approx(2000.0)
+
+    cluster.mark_disk_dead(0, "/d0")
+    assert cluster.broker_state(0) == BrokerState.BAD_DISKS
+    state2, maps2 = cluster.to_arrays()
+    offline = np.asarray(state2.broker_offline_replicas)
+    assert offline.sum() == 1
+
+    # a cross-broker move resets the logdir assignment: the source disk stops
+    # being charged and the dead source disk no longer marks the replica offline
+    moved = A.relocate_replicas(state2, np.array([0]), np.array([maps2.broker_index[1]]))
+    assert int(np.asarray(moved.replica_disk)[0]) == -1
+    assert np.asarray(moved.replica_offline_mask()).sum() == 0
+    dl2 = np.asarray(A.disk_load(moved))
+    assert dl2[maps2.disk_index[(0, "/d0")]] == pytest.approx(0.0)
+
+
+def test_padding_rows_are_inert():
+    state, _ = fixtures.unbalanced().to_arrays(pad_replicas_to=16)
+    assert state.num_replicas == 16
+    assert np.asarray(state.replica_valid).sum() == 2
+    load = np.asarray(A.broker_load(state))
+    np.testing.assert_allclose(load[0], [100.0, 300000.0, 200000.0, 300000.0], rtol=1e-5)
+
+
+def test_cluster_stats():
+    state, _ = fixtures.unbalanced().to_arrays()
+    st = S.cluster_model_stats(state, balance_percentage=1.1)
+    np.testing.assert_allclose(np.asarray(st["util_avg"])[Resource.CPU], 100.0 / 3, rtol=1e-5)
+    assert float(np.asarray(st["util_max"])[Resource.CPU]) == pytest.approx(100.0)
+    assert float(np.asarray(st["util_min"])[Resource.CPU]) == 0.0
+    assert int(st["num_alive_brokers"]) == 3
+    assert int(st["total_replicas"]) == 2
+    # nobody is inside the balance band around avg=33.3 (brokers are 100/0/0)
+    assert np.asarray(st["num_balanced_by_resource"])[Resource.CPU] == 0
+    std = float(S.utilization_std(state, Resource.CPU))
+    assert std == pytest.approx(np.std([100.0, 0.0, 0.0]), rel=1e-5)
+
+
+def test_diff_empty_when_unchanged():
+    state, maps = fixtures.unbalanced().to_arrays()
+    assert diff(state, state, maps) == []
+
+
+def test_diff_replica_move_and_leadership():
+    state, maps = fixtures.rack_aware_satisfiable().to_arrays()
+    rb = np.asarray(state.replica_broker)
+    follower_row = int(np.nonzero(rb == maps.broker_index[1])[0][0])
+    # move follower 1 -> 2, then make it leader
+    final = A.relocate_replicas(state, np.array([follower_row]), np.array([maps.broker_index[2]]))
+    final = A.relocate_leadership(final, np.array([0]), np.array([follower_row]))
+    props = diff(state, final, maps)
+    assert len(props) == 1
+    p = props[0]
+    assert p.tp == ("T1", 0)
+    assert p.old_leader == 0
+    assert p.new_leader == 2
+    assert p.old_replicas == (0, 1)
+    assert set(p.new_replicas) == {0, 2}
+    assert p.new_replicas[0] == 2
+    assert p.replicas_to_add == (2,)
+    assert p.replicas_to_remove == (1,)
+    assert p.has_leader_action and p.has_replica_action
+
+
+def test_diff_leadership_only():
+    state, maps = fixtures.rack_aware_satisfiable().to_arrays()
+    rb = np.asarray(state.replica_broker)
+    follower_row = int(np.nonzero(rb == maps.broker_index[1])[0][0])
+    final = A.relocate_leadership(state, np.array([0]), np.array([follower_row]))
+    props = diff(state, final, maps)
+    assert len(props) == 1
+    assert props[0].has_leader_action and not props[0].has_replica_action
+    assert props[0].new_leader == 1
+
+
+def test_host_model_queries():
+    cluster = fixtures.rack_aware_satisfiable()
+    assert cluster.replica_distribution() == {("T1", 0): [0, 1]}
+    assert cluster.leader_distribution() == {("T1", 0): 0}
+    cluster.delete_replica(1, ("T1", 0))
+    assert cluster.replica_distribution() == {("T1", 0): [0]}
+    with pytest.raises(ValueError):
+        cluster.delete_replica(1, ("T1", 0))
+    with pytest.raises(ValueError):
+        cluster.create_replica(0, ("T1", 0), 0, True)  # duplicate on same broker
+    with pytest.raises(ValueError):
+        cluster.create_replica(2, ("T1", 0), 2, True)  # second leader
