@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Benchmark: full rebalance proposal generation on a skewed synthetic cluster.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Scale = BASELINE.md config #2 (100 brokers / 10k partitions, RF 3 → 30k replicas,
+exponential load, skewed onto 1/4 of the brokers).  The measured value is the
+steady-state (post-compile) wall-clock of a complete GoalOptimizer run over the full
+default goal list — the number the reference exposes as its
+``proposal-computation-timer`` (GoalOptimizer.java:84).  The reference publishes no
+benchmark figures (BASELINE.md), so ``vs_baseline`` is reported against this
+project's own north-star budget of 30 s for a full rebalance
+(value 1.0 == exactly on budget; >1 == faster than budget).
+"""
+
+import json
+import time
+
+SCALE = dict(
+    num_racks=10,
+    num_brokers=100,
+    num_topics=100,
+    num_partitions=10_000,
+    replication_factor=3,
+)
+NORTH_STAR_BUDGET_S = 30.0
+
+
+def build():
+    from cruise_control_tpu.analyzer import GoalContext
+    from cruise_control_tpu.synthetic import SyntheticSpec, generate
+
+    spec = SyntheticSpec(
+        **SCALE,
+        distribution="exponential",
+        skew_brokers=25,
+        mean_cpu=0.25,
+        mean_disk=0.3,
+        mean_nw_in=0.2,
+        mean_nw_out=0.15,
+        seed=7,
+    )
+    state, maps = generate(spec)
+    ctx = GoalContext.build(state.num_topics, state.num_brokers)
+    return state, ctx, maps
+
+
+def run_once(state, ctx):
+    from cruise_control_tpu.analyzer import GoalOptimizer
+
+    opt = GoalOptimizer(enable_heavy_goals=True)
+    final, result = opt.optimize(state, ctx)
+    return result
+
+
+def main() -> None:
+    state, ctx, maps = build()
+    run_once(state, ctx)              # compile warm-up
+    t0 = time.monotonic()
+    result = run_once(state, ctx)
+    wall = time.monotonic() - t0
+
+    residual_hard = sum(
+        result.violations_after[name] for name in result.violated_hard_goals
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "rebalance_proposal_wall_s_100brokers_10kpartitions",
+                "value": round(wall, 3),
+                "unit": "s",
+                "vs_baseline": round(NORTH_STAR_BUDGET_S / max(wall, 1e-9), 2),
+                "residual_hard_violations": residual_hard,
+                "total_moves": result.total_moves,
+                "balancedness": round(result.balancedness_score, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Analyzer layer: the TPU-native GoalOptimizer.
+
+Counterpart of ``cruise-control/src/main/java/.../analyzer/`` — see
+:mod:`cruise_control_tpu.analyzer.optimizer` for the architecture notes.
+"""
+
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.context import GoalContext
+from cruise_control_tpu.analyzer.optimizer import (
+    GoalOptimizer,
+    GoalReport,
+    OptimizationFailure,
+    OptimizerResult,
+)
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal, diff
+
+__all__ = [
+    "BalancingConstraint",
+    "GoalContext",
+    "GoalOptimizer",
+    "GoalReport",
+    "OptimizationFailure",
+    "OptimizerResult",
+    "ExecutionProposal",
+    "diff",
+]

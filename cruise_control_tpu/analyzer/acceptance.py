@@ -1,0 +1,211 @@
+"""Vectorized per-goal action acceptance.
+
+Counterpart of ``Goal.actionAcceptance`` (``analyzer/goals/Goal.java:81``) and the
+``maybeApplyBalancingAction`` veto loop (``AbstractGoal.java:230``): an action is only
+applied if *every previously optimized goal* accepts it.  Here acceptance is evaluated
+for a whole :class:`MoveBatch` at once, and the set of enforcing goals arrives as a
+**traced** ``prior_mask`` bool[NUM_GOALS] — so one compiled round step serves every
+position in any goal priority list.
+
+Each kernel encodes the reference goal's documented rule, e.g. for distribution goals
+(ResourceDistributionGoal.java:100-160): "never make a balanced broker unbalanced;
+otherwise never increase the utilization difference".  All kernels read the
+pre-round :class:`Snapshot` — valid because conflict resolution admits at most one
+action per destination broker and per partition per round.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.context import GoalContext, Snapshot
+from cruise_control_tpu.analyzer.moves import (
+    KIND_LEADERSHIP,
+    KIND_REPLICA_MOVE,
+    KIND_SWAP,
+    MoveBatch,
+    MoveEffects,
+)
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.model.arrays import ClusterArrays
+
+
+def _rack_ok_one_direction(state, snap, partition, src_broker, dst_broker):
+    """Moving one replica of ``partition`` src→dst keeps rack uniqueness."""
+    src_rack = state.broker_rack[src_broker]
+    dst_rack = state.broker_rack[dst_broker]
+    occupied = snap.rack_counts[partition, dst_rack] - (src_rack == dst_rack).astype(jnp.int32)
+    return occupied == 0
+
+
+def accept_rack_aware(state, ctx, snap, moves, eff):
+    """RackAwareGoal: reject replica moves/swaps into a rack that already hosts
+    another replica of the partition."""
+    kind = moves.kind
+    fwd = _rack_ok_one_direction(state, snap, eff.partition, eff.src_broker, eff.dst_broker)
+    partner = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+    p2 = state.replica_partition[partner]
+    bwd = _rack_ok_one_direction(state, snap, p2, eff.dst_broker, eff.src_broker)
+    ok_swap = fwd & bwd
+    return jnp.where(kind == KIND_LEADERSHIP, True, jnp.where(kind == KIND_SWAP, ok_swap, fwd))
+
+
+def accept_min_topic_leaders(state, ctx, snap, moves, eff):
+    """MinTopicLeadersPerBrokerGoal (:52): don't drop a broker's leader count for a
+    protected topic below the minimum by moving leadership (or a leader) away."""
+    if not snap.enable_heavy:
+        return jnp.ones(moves.num_slots, bool)
+    topic = state.partition_topic[eff.partition]
+    protected = ctx.min_leader_topics[topic]
+    loses = eff.leader_delta_src < 0
+    after = snap.topic_leader_counts[eff.src_broker, topic] + eff.leader_delta_src
+    ok = after >= ctx.constraint.min_topic_leaders_per_broker
+    return ~(protected & loses) | ok
+
+
+def accept_replica_capacity(state, ctx, snap, moves, eff):
+    """ReplicaCapacityGoal: destination stays within max replicas per broker."""
+    after = snap.replica_counts[eff.dst_broker] + eff.count_delta
+    return after <= ctx.constraint.max_replicas_per_broker
+
+
+def accept_capacity(state, ctx, snap, moves, eff, res: int):
+    """CapacityGoal (CapacityGoal.java:41): the destination must stay under
+    ``capacity_threshold · capacity``; load-reducing deltas are always fine."""
+    limit = snap.cap_limits[:, res]
+    delta = eff.delta_dst[:, res]
+    after = snap.broker_load[eff.dst_broker, res] + delta
+    return (after <= limit[eff.dst_broker]) | (delta <= 0.0)
+
+
+def accept_potential_nw_out(state, ctx, snap, moves, eff):
+    """PotentialNwOutGoal (:42): destination's potential outbound (every replica
+    promoted) stays within the NW_OUT capacity threshold."""
+    p = eff.partition
+    leader_nw = (
+        state.base_load[jnp.maximum(moves.replica, 0), Resource.NW_OUT]
+        + state.leadership_delta[p, Resource.NW_OUT]
+    )
+    kind = moves.kind
+    partner = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+    partner_nw = (
+        state.base_load[partner, Resource.NW_OUT]
+        + state.leadership_delta[state.replica_partition[partner], Resource.NW_OUT]
+    )
+    delta = jnp.where(
+        kind == KIND_REPLICA_MOVE, leader_nw,
+        jnp.where(kind == KIND_SWAP, leader_nw - partner_nw, 0.0),
+    )
+    limit = snap.cap_limits[:, Resource.NW_OUT]
+    after = snap.potential_nw_out[eff.dst_broker] + delta
+    return (after <= limit[eff.dst_broker]) | (delta <= 0.0)
+
+
+def accept_replica_count_dist(state, ctx, snap, moves, eff):
+    """ReplicaDistributionGoal: keep the destination inside the band, or at least
+    strictly less crowded than the source was (never invert the imbalance)."""
+    upper = snap.replica_band[1]
+    dst_after = snap.replica_counts[eff.dst_broker] + eff.count_delta
+    src_before = snap.replica_counts[eff.src_broker]
+    return (eff.count_delta <= 0) | (dst_after <= upper) | (dst_after <= src_before - 1)
+
+
+def accept_resource_dist(state, ctx, snap, moves, eff, res: int):
+    """ResourceDistributionGoal.actionAcceptance (ResourceDistributionGoal.java:100-160).
+
+    If both endpoints were inside the balance band, they must both stay inside;
+    otherwise the action must not leave the destination more utilized (in % of
+    capacity) than the source was.  Low-utilization resources accept everything.
+    """
+    lower, upper = snap.res_lower, snap.res_upper
+    low = snap.low_util[res]
+    cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+
+    src, dst = eff.src_broker, eff.dst_broker
+    src_before = snap.broker_load[src, res]
+    dst_before = snap.broker_load[dst, res]
+    src_after = src_before + eff.delta_src[:, res]
+    dst_after = dst_before + eff.delta_dst[:, res]
+
+    within_before = (src_before >= lower[src, res]) & (dst_before <= upper[dst, res])
+    ok_within = (dst_after <= upper[dst, res]) & (src_after >= lower[src, res])
+    ok_fallback = dst_after / cap[dst] <= src_before / cap[src]
+    no_load = jnp.abs(eff.delta_dst[:, res]) <= 0.0
+    return low | no_load | jnp.where(within_before, ok_within, ok_fallback)
+
+
+def accept_leader_count_dist(state, ctx, snap, moves, eff):
+    """LeaderReplicaDistributionGoal: destination leader count stays in band or
+    below the source's pre-move count."""
+    upper = snap.leader_band[1]
+    dst_after = snap.leader_counts[eff.dst_broker] + eff.leader_delta_dst
+    src_before = snap.leader_counts[eff.src_broker]
+    return (eff.leader_delta_dst <= 0) | (dst_after <= upper) | (dst_after <= src_before - 1)
+
+
+def accept_topic_replica_dist(state, ctx, snap, moves, eff):
+    """TopicReplicaDistributionGoal: per-topic destination count stays in band or
+    below the source's."""
+    if not snap.enable_heavy:
+        return jnp.ones(moves.num_slots, bool)
+    bt = snap.topic_counts
+    topic = state.partition_topic[eff.partition]
+    tup = snap.topic_band[1]
+    dst_after = bt[eff.dst_broker, topic] + eff.count_delta
+    src_before = bt[eff.src_broker, topic]
+    return (eff.count_delta <= 0) | (dst_after <= tup[topic]) | (dst_after <= src_before - 1)
+
+
+def accept_leader_bytes_in(state, ctx, snap, moves, eff):
+    """LeaderBytesInDistributionGoal (:50): destination leader-bytes-in stays under
+    the upper band or under the source's pre-move value."""
+    nw_in = snap.eff_load[jnp.maximum(moves.replica, 0), Resource.NW_IN]
+    gains = eff.leader_delta_dst > 0
+    delta = jnp.where(gains, nw_in, 0.0)
+    after = snap.leader_nw_in[eff.dst_broker] + delta
+    return (
+        (~gains)
+        | (after <= snap.leader_nw_in_upper)
+        | (after <= snap.leader_nw_in[eff.src_broker])
+    )
+
+
+_KERNELS = {
+    G.RACK_AWARE: accept_rack_aware,
+    G.MIN_TOPIC_LEADERS: accept_min_topic_leaders,
+    G.REPLICA_CAPACITY: accept_replica_capacity,
+    G.REPLICA_DISTRIBUTION: accept_replica_count_dist,
+    G.POTENTIAL_NW_OUT: accept_potential_nw_out,
+    G.TOPIC_REPLICA_DIST: accept_topic_replica_dist,
+    G.LEADER_REPLICA_DIST: accept_leader_count_dist,
+    G.LEADER_BYTES_IN_DIST: accept_leader_bytes_in,
+}
+
+
+def accept_all(
+    state: ClusterArrays,
+    ctx: GoalContext,
+    snap: Snapshot,
+    moves: MoveBatch,
+    eff: MoveEffects,
+    prior_mask: jax.Array,
+) -> jax.Array:
+    """bool[K]: every goal enabled in ``prior_mask`` accepts each slot.
+
+    ``prior_mask`` is traced, so the same compiled step serves every goal position;
+    disabled goals contribute a constant True.
+    """
+    ok = eff.valid
+    for gid, fn in _KERNELS.items():
+        ok = ok & jnp.where(prior_mask[gid], fn(state, ctx, snap, moves, eff), True)
+    for gid, res in G.CAPACITY_RESOURCE.items():
+        ok = ok & jnp.where(
+            prior_mask[gid], accept_capacity(state, ctx, snap, moves, eff, res), True
+        )
+    for gid, res in G.DIST_RESOURCE.items():
+        ok = ok & jnp.where(
+            prior_mask[gid], accept_resource_dist(state, ctx, snap, moves, eff, res), True
+        )
+    return ok

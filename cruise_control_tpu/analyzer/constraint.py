@@ -1,0 +1,146 @@
+"""Balancing constraint: the analyzer's threshold bundle.
+
+Counterpart of ``analyzer/BalancingConstraint.java:24-41`` built from the knobs in
+``config/constants/AnalyzerConfig.java`` (balance thresholds :58-114, capacity
+thresholds :179-209, low-utilization thresholds :217-245, max replicas per broker
+:263-264).  Represented as a jax pytree of traced scalars/vectors so a solver compiled
+once can be re-run under different thresholds without recompilation (e.g. the goal-
+violation detector's threshold multiplier).
+
+Resource vector ordering follows :class:`~cruise_control_tpu.core.resources.Resource`:
+[CPU, NW_IN, NW_OUT, DISK].
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cruise_control_tpu.core.resources import NUM_RESOURCES, Resource
+
+#: Reference ``ResourceDistributionGoal.BALANCE_MARGIN`` (:57) — the fraction of the
+#: configured balance percentage actually used, so optimization overshoots slightly
+#: and detection (at the full percentage) doesn't flap.
+BALANCE_MARGIN = 0.9
+
+
+@struct.dataclass
+class BalancingConstraint:
+    """Thresholds driving goal feasibility/penalty kernels (all traced)."""
+
+    # f32[4] indexed by Resource; "1.10" == up to 10% above average is balanced.
+    resource_balance_threshold: jax.Array
+    # f32[4]; fraction of capacity usable before a broker counts as over capacity.
+    resource_capacity_threshold: jax.Array
+    # f32[4]; below this avg utilization the distribution goals consider the
+    # resource too idle to balance.
+    low_utilization_threshold: jax.Array
+    replica_balance_threshold: jax.Array        # f32 scalar
+    leader_replica_balance_threshold: jax.Array  # f32
+    topic_replica_balance_threshold: jax.Array   # f32
+    max_replicas_per_broker: jax.Array           # i32
+    #: AnalyzerConfig ``goal.violation.distribution.threshold.multiplier`` — the
+    #: detector widens balance bands by this factor to avoid flapping.
+    distribution_threshold_multiplier: jax.Array  # f32
+    balance_margin: jax.Array                    # f32, BALANCE_MARGIN
+    #: MinTopicLeadersPerBrokerGoal's ``min.topic.leaders.per.broker`` count.
+    min_topic_leaders_per_broker: jax.Array      # i32
+    #: Gap bounds for the count-based distribution goals
+    #: (``topic.replica.count.balance.min/max.gap``, AnalyzerConfig :160,170).
+    topic_replica_balance_min_gap: jax.Array     # i32
+    topic_replica_balance_max_gap: jax.Array     # i32
+
+    @classmethod
+    def default(
+        cls,
+        *,
+        resource_balance_threshold: Optional[Mapping[Resource, float]] = None,
+        resource_capacity_threshold: Optional[Mapping[Resource, float]] = None,
+        low_utilization_threshold: Optional[Mapping[Resource, float]] = None,
+        replica_balance_threshold: float = 1.10,
+        leader_replica_balance_threshold: float = 1.10,
+        topic_replica_balance_threshold: float = 3.00,
+        max_replicas_per_broker: int = 10000,
+        distribution_threshold_multiplier: float = 1.0,
+        balance_margin: float = BALANCE_MARGIN,
+        min_topic_leaders_per_broker: int = 1,
+        topic_replica_balance_min_gap: int = 2,
+        topic_replica_balance_max_gap: int = 40,
+    ) -> "BalancingConstraint":
+        """Defaults mirror AnalyzerConfig.java (:59,68,77,86 balance=1.10;
+        :180 cpu capacity=0.7, :189-208 others=0.8; :218-245 low-util=0.0;
+        :95,104 count balance=1.10; :151 topic replica balance=3.0; :264 max
+        replicas/broker=10000)."""
+        bal = jnp.ones(NUM_RESOURCES, jnp.float32) * 1.10
+        cap = jnp.array([0.7, 0.8, 0.8, 0.8], jnp.float32)  # CPU, NW_IN, NW_OUT, DISK
+        low = jnp.zeros(NUM_RESOURCES, jnp.float32)
+        if resource_balance_threshold:
+            for r, v in resource_balance_threshold.items():
+                bal = bal.at[r].set(v)
+        if resource_capacity_threshold:
+            for r, v in resource_capacity_threshold.items():
+                cap = cap.at[r].set(v)
+        if low_utilization_threshold:
+            for r, v in low_utilization_threshold.items():
+                low = low.at[r].set(v)
+        f32 = lambda v: jnp.asarray(v, jnp.float32)
+        i32 = lambda v: jnp.asarray(v, jnp.int32)
+        return cls(
+            resource_balance_threshold=bal,
+            resource_capacity_threshold=cap,
+            low_utilization_threshold=low,
+            replica_balance_threshold=f32(replica_balance_threshold),
+            leader_replica_balance_threshold=f32(leader_replica_balance_threshold),
+            topic_replica_balance_threshold=f32(topic_replica_balance_threshold),
+            max_replicas_per_broker=i32(max_replicas_per_broker),
+            distribution_threshold_multiplier=f32(distribution_threshold_multiplier),
+            balance_margin=f32(balance_margin),
+            min_topic_leaders_per_broker=i32(min_topic_leaders_per_broker),
+            topic_replica_balance_min_gap=i32(topic_replica_balance_min_gap),
+            topic_replica_balance_max_gap=i32(topic_replica_balance_max_gap),
+        )
+
+    # -- derived band helpers (GoalUtils.computeResourceUtilizationBalanceThreshold,
+    #    GoalUtils.java:550-575) ---------------------------------------------------
+
+    def balance_percentage_with_margin(self, triggered_by_violation: jax.Array) -> jax.Array:
+        """f32[4]: (threshold - 1) · margin, widened for the violation detector."""
+        mult = jnp.where(triggered_by_violation, self.distribution_threshold_multiplier, 1.0)
+        return (self.resource_balance_threshold * mult - 1.0) * self.balance_margin
+
+    def utilization_bands(
+        self, avg_utilization_pct: jax.Array, triggered_by_violation: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """(lower_pct f32[4], upper_pct f32[4]) balance band around the average.
+
+        Low-utilization handling mirrors GoalUtils.java:560-575: below the
+        low-utilization threshold the lower bound collapses to 0 and the upper bound
+        is floored at ``low_util_threshold · margin``.
+        """
+        bpm = self.balance_percentage_with_margin(triggered_by_violation)
+        is_low = avg_utilization_pct <= self.low_utilization_threshold
+        lower = jnp.where(is_low, 0.0, avg_utilization_pct * jnp.maximum(0.0, 1.0 - bpm))
+        upper = avg_utilization_pct * (1.0 + bpm)
+        upper = jnp.where(
+            is_low,
+            jnp.maximum(upper, self.low_utilization_threshold * self.balance_margin),
+            upper,
+        )
+        return lower, upper
+
+    def count_band(
+        self, avg_count: jax.Array, threshold: jax.Array, triggered_by_violation: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """(lower i32, upper i32) band for count-based goals.
+
+        Mirrors ReplicaDistributionAbstractGoal.initGoalState: upper =
+        ceil(avg·(1+pct·margin)), lower = floor(avg·max(0, 1-pct·margin)).
+        """
+        mult = jnp.where(triggered_by_violation, self.distribution_threshold_multiplier, 1.0)
+        pct = (threshold * mult - 1.0) * self.balance_margin
+        upper = jnp.ceil(avg_count * (1.0 + pct)).astype(jnp.int32)
+        lower = jnp.floor(avg_count * jnp.maximum(0.0, 1.0 - pct)).astype(jnp.int32)
+        return lower, upper

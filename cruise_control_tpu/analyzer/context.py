@@ -1,0 +1,248 @@
+"""Optimization options and the per-round snapshot shared by goal kernels.
+
+``GoalContext`` is the array form of ``analyzer/OptimizationOptions.java`` (excluded
+topics / brokers-for-leadership / brokers-for-replica-move, fast mode,
+onlyMoveImmigrantReplicas) plus the :class:`BalancingConstraint`.  ``Snapshot`` bundles
+every derived tensor the goal kernels need — effective loads, per-broker loads and
+counts, rack occupancy, capacity limits, balance bands — computed once per optimizer
+round.  Precomputing them here keeps each goal/acceptance kernel down to gathers and
+comparisons, which both shrinks traces (compile time) and lets XLA fuse one round into
+a handful of kernels.
+
+The [B, T]-shaped tensors (per-topic counts) are only materialized when
+``enable_heavy`` is set; at 10k-broker scale they dominate memory and the optimizer
+disables the goals that need them unless explicitly requested.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.core.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model import arrays as A
+from cruise_control_tpu.model.arrays import ClusterArrays
+
+NEG = jnp.float32(-3e38)
+
+
+@struct.dataclass
+class GoalContext:
+    constraint: BalancingConstraint
+    excluded_topics: jax.Array             # bool[T]
+    excluded_for_leadership: jax.Array     # bool[B]
+    excluded_for_replica_move: jax.Array   # bool[B]
+    only_move_immigrants: jax.Array        # bool scalar
+    triggered_by_violation: jax.Array      # bool scalar — widens distribution bands
+    #: bool[T] topics subject to MinTopicLeadersPerBrokerGoal's pattern
+    #: (``topics.with.min.leaders.per.broker``); all-False disables the goal.
+    min_leader_topics: jax.Array
+    fast_mode: jax.Array                   # bool scalar
+
+    @classmethod
+    def build(
+        cls,
+        num_topics: int,
+        num_brokers: int,
+        constraint: Optional[BalancingConstraint] = None,
+        excluded_topic_ids: Sequence[int] = (),
+        excluded_brokers_for_leadership: Sequence[int] = (),
+        excluded_brokers_for_replica_move: Sequence[int] = (),
+        only_move_immigrants: bool = False,
+        triggered_by_violation: bool = False,
+        min_leader_topic_ids: Sequence[int] = (),
+        fast_mode: bool = False,
+    ) -> "GoalContext":
+        et = jnp.zeros(num_topics, bool)
+        if excluded_topic_ids:
+            et = et.at[jnp.asarray(list(excluded_topic_ids), jnp.int32)].set(True)
+        el = jnp.zeros(num_brokers, bool)
+        if excluded_brokers_for_leadership:
+            el = el.at[jnp.asarray(list(excluded_brokers_for_leadership), jnp.int32)].set(True)
+        er = jnp.zeros(num_brokers, bool)
+        if excluded_brokers_for_replica_move:
+            er = er.at[jnp.asarray(list(excluded_brokers_for_replica_move), jnp.int32)].set(True)
+        ml = jnp.zeros(num_topics, bool)
+        if min_leader_topic_ids:
+            ml = ml.at[jnp.asarray(list(min_leader_topic_ids), jnp.int32)].set(True)
+        return cls(
+            constraint=constraint if constraint is not None else BalancingConstraint.default(),
+            excluded_topics=et,
+            excluded_for_leadership=el,
+            excluded_for_replica_move=er,
+            only_move_immigrants=jnp.asarray(only_move_immigrants),
+            triggered_by_violation=jnp.asarray(triggered_by_violation),
+            min_leader_topics=ml,
+            fast_mode=jnp.asarray(fast_mode),
+        )
+
+
+@struct.dataclass
+class Snapshot:
+    """Derived tensors for one optimizer round (all pure functions of the state)."""
+
+    eff_load: jax.Array        # f32[R, 4]
+    is_leader: jax.Array       # bool[R]
+    broker_load: jax.Array     # f32[B, 4]
+    replica_counts: jax.Array  # i32[B]
+    leader_counts: jax.Array   # i32[B]
+    potential_nw_out: jax.Array  # f32[B]
+    rack_counts: jax.Array     # i32[P, num_racks] replicas of partition per rack
+    util_pct: jax.Array        # f32[B, 4] utilization / capacity
+    movable: jax.Array         # bool[R] replica may be relocated at all
+    topic_allowed: jax.Array   # bool[R] replica's topic is not excluded
+    leader_movable: jax.Array  # bool[R] leadership may be moved *to* this replica
+    dest_ok: jax.Array         # bool[B] broker eligible as replica-move destination
+    offline: jax.Array         # bool[R] replica must leave its broker/disk
+
+    # thresholds / bands (precomputed once per round)
+    avg_util_pct: jax.Array    # f32[4]
+    cap_limits: jax.Array      # f32[B, 4] capacity_threshold · capacity
+    res_lower: jax.Array       # f32[B, 4] distribution band lower bound (absolute)
+    res_upper: jax.Array       # f32[B, 4] distribution band upper bound (absolute)
+    low_util: jax.Array        # bool[4]
+    replica_band: jax.Array    # i32[2] (lower, upper) replicas per broker
+    leader_band: jax.Array     # i32[2] (lower, upper) leaders per broker
+    leader_nw_in: jax.Array    # f32[B] bytes-in of leader replicas per broker
+    leader_nw_in_upper: jax.Array  # f32 scalar upper band for leader bytes-in
+
+    # heavy [B, T] tensors — None unless enable_heavy
+    topic_counts: Optional[jax.Array] = None       # i32[B, T]
+    topic_band: Optional[jax.Array] = None         # i32[2, T] (lower, upper)
+    topic_leader_counts: Optional[jax.Array] = None  # i32[B, T]
+
+    enable_heavy: bool = struct.field(pytree_node=False, default=False)
+
+
+def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = False) -> Snapshot:
+    eff = A.effective_load(state)
+    lead = A.is_leader(state)
+    bload = A.broker_load(state)
+    topic = state.partition_topic[state.replica_partition]
+    offline = state.replica_offline_mask()
+    immigrant = state.replica_broker != state.original_broker
+    topic_allowed = state.replica_valid & ~ctx.excluded_topics[topic]
+    movable = topic_allowed & (~ctx.only_move_immigrants | immigrant | offline)
+    dest_ok = state.broker_alive & ~ctx.excluded_for_replica_move
+    leader_movable = (
+        state.replica_valid
+        & state.broker_alive[state.replica_broker]
+        & ~state.broker_demoted[state.replica_broker]
+        & ~ctx.excluded_for_leadership[state.replica_broker]
+        & ~offline
+    )
+    cap = jnp.maximum(state.broker_capacity, 1e-9)
+    replica_counts = A.broker_replica_counts(state)
+    leader_counts = A.broker_leader_counts(state)
+
+    alive = state.broker_alive
+    n_alive = jnp.maximum(alive.sum(), 1)
+    total_load = jnp.where(alive[:, None], bload, 0.0).sum(axis=0)
+    total_cap = jnp.where(alive[:, None], state.broker_capacity, 0.0).sum(axis=0)
+    avg_pct = total_load / jnp.maximum(total_cap, 1e-9)
+
+    c = ctx.constraint
+    lower_pct, upper_pct = c.utilization_bands(avg_pct, ctx.triggered_by_violation)
+    res_lower = lower_pct[None, :] * state.broker_capacity
+    res_upper = upper_pct[None, :] * state.broker_capacity
+    res_lower = jnp.where(ctx.excluded_for_replica_move[:, None], 0.0, res_lower)
+    low_util = avg_pct <= c.low_utilization_threshold
+
+    r_lo, r_up = c.count_band(
+        replica_counts.sum().astype(jnp.float32) / n_alive,
+        c.replica_balance_threshold,
+        ctx.triggered_by_violation,
+    )
+    l_lo, l_up = c.count_band(
+        leader_counts.sum().astype(jnp.float32) / n_alive,
+        c.leader_replica_balance_threshold,
+        ctx.triggered_by_violation,
+    )
+
+    lbi = jax.ops.segment_sum(
+        jnp.where(lead, eff[:, Resource.NW_IN], 0.0),
+        state.replica_broker,
+        num_segments=state.num_brokers,
+    )
+    lbi_avg = jnp.where(alive, lbi, 0.0).sum() / n_alive
+    bpm = c.balance_percentage_with_margin(ctx.triggered_by_violation)
+    lbi_upper = lbi_avg * (1.0 + bpm[Resource.NW_IN])
+
+    topic_counts = topic_band = topic_leader_counts = None
+    if enable_heavy:
+        topic_counts = A.topic_replica_counts_by_broker(state)
+        totals = topic_counts.sum(axis=0)
+        avg_t = totals.astype(jnp.float32) / n_alive
+        mult = jnp.where(ctx.triggered_by_violation, c.distribution_threshold_multiplier, 1.0)
+        pct = (c.topic_replica_balance_threshold * mult - 1.0) * c.balance_margin
+        gap = jnp.ceil(avg_t * pct).astype(jnp.int32)
+        gap = jnp.clip(gap, c.topic_replica_balance_min_gap, c.topic_replica_balance_max_gap)
+        t_up = jnp.floor(avg_t).astype(jnp.int32) + gap
+        t_lo = jnp.maximum(0, jnp.ceil(avg_t).astype(jnp.int32) - gap)
+        topic_band = jnp.stack([t_lo, t_up])
+        flat = state.replica_broker * state.num_topics + topic
+        topic_leader_counts = jax.ops.segment_sum(
+            lead.astype(jnp.int32), flat,
+            num_segments=state.num_brokers * state.num_topics,
+        ).reshape(state.num_brokers, state.num_topics)
+
+    return Snapshot(
+        eff_load=eff,
+        is_leader=lead,
+        broker_load=bload,
+        replica_counts=replica_counts,
+        leader_counts=leader_counts,
+        potential_nw_out=A.potential_nw_out(state),
+        rack_counts=A.replicas_per_rack_per_partition(state),
+        util_pct=bload / cap,
+        movable=movable,
+        topic_allowed=topic_allowed,
+        leader_movable=leader_movable,
+        dest_ok=dest_ok,
+        offline=offline,
+        avg_util_pct=avg_pct,
+        cap_limits=c.resource_capacity_threshold[None, :] * state.broker_capacity,
+        res_lower=res_lower,
+        res_upper=res_upper,
+        low_util=low_util,
+        replica_band=jnp.stack([r_lo, r_up]),
+        leader_band=jnp.stack([l_lo, l_up]),
+        leader_nw_in=lbi,
+        leader_nw_in_upper=lbi_upper,
+        topic_counts=topic_counts,
+        topic_band=topic_band,
+        topic_leader_counts=topic_leader_counts,
+        enable_heavy=enable_heavy,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Small shared kernels.
+# ---------------------------------------------------------------------------
+
+
+def segment_argmax(
+    scores: jax.Array, seg: jax.Array, num_segments: int, eligible: jax.Array
+) -> jax.Array:
+    """i32[S]: index of the max-score eligible element per segment, -1 if none.
+
+    Deterministic (ties break to the lowest index) — the vectorized replacement for
+    the reference's ``SortedReplicas`` candidate walk (SortedReplicas.java:47).
+    """
+    s = jnp.where(eligible, scores, NEG)
+    smax = jax.ops.segment_max(s, seg, num_segments=num_segments)
+    idx = jnp.arange(scores.shape[0], dtype=jnp.int32)
+    hit = eligible & (s >= smax[seg]) & (s > NEG / 2)
+    big = jnp.int32(2**30)
+    best = jax.ops.segment_min(jnp.where(hit, idx, big), seg, num_segments=num_segments)
+    return jnp.where(best < big, best, -1)
+
+
+def avg_utilization_pct(state: ClusterArrays, snap: Snapshot) -> jax.Array:
+    """f32[4]: cluster avg utilization over alive-broker capacity
+    (ResourceDistributionGoal.java:248)."""
+    return snap.avg_util_pct

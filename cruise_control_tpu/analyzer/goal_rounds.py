@@ -1,0 +1,492 @@
+"""Per-goal round functions: each goal's ``rebalanceForBroker`` as batched kernels.
+
+Every entry in :data:`GOAL_ROUNDS` maps a goal id to an ordered tuple of round
+functions ``(state, ctx, snap) -> MoveBatch``.  The optimizer drives each round type
+to convergence in order (e.g. leadership transfers before replica moves, matching
+ResourceDistributionGoal.java:380's phasing), then moves to the next goal.
+
+Round functions only *propose improving actions for this goal*; the optimizer layers
+prior-goal acceptance and conflict resolution on top.  All band/limit tensors come
+precomputed from the :class:`Snapshot`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.context import GoalContext, Snapshot
+from cruise_control_tpu.analyzer.moves import MoveBatch
+from cruise_control_tpu.analyzer.proposers import (
+    fill_round,
+    leadership_fill_round,
+    leadership_shed_round,
+    shed_round,
+)
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.model.arrays import ClusterArrays
+
+RoundFn = Callable[[ClusterArrays, GoalContext, Snapshot], MoveBatch]
+
+NEG = jnp.float32(-3e38)
+
+
+def _counts_f(snap: Snapshot) -> jax.Array:
+    return snap.replica_counts.astype(jnp.float32)
+
+
+def _bcast(row: jax.Array, n: int) -> jax.Array:
+    """[B] -> [n, B] broadcast without copy semantics."""
+    return jnp.broadcast_to(row[None, :], (n, row.shape[0]))
+
+
+# -- offline repair (pre-phase) ----------------------------------------------------
+
+
+def offline_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    """Move replicas off dead brokers/disks — the array analogue of the requirement
+    that every goal first relocates offline replicas (self-healing semantics of
+    AbstractGoal's dead-broker handling).  Destinations must be rack-safe and under
+    all capacity limits so the subsequent goal phases start from a feasible point."""
+    offline_per_broker = jax.ops.segment_sum(
+        snap.offline.astype(jnp.float32), state.replica_broker,
+        num_segments=state.num_brokers,
+    )
+
+    def dst_fn(cand: jax.Array):
+        p = state.replica_partition[cand]
+        src_rack = state.broker_rack[state.replica_broker[cand]]
+        occ = snap.rack_counts[p][:, state.broker_rack]  # [S, B] count in dst rack
+        occ = occ - (src_rack[:, None] == state.broker_rack[None, :]).astype(jnp.int32)
+        rack_ok = occ == 0
+        load_after = snap.broker_load[None, :, :] + snap.eff_load[cand][:, None, :]
+        fits = jnp.all(load_after <= snap.cap_limits[None, :, :], axis=-1)
+        count_ok = (snap.replica_counts + 1 <= ctx.constraint.max_replicas_per_broker)[None, :]
+        score = _bcast(-snap.util_pct.max(axis=-1), cand.shape[0])
+        return rack_ok & fits & count_ok, score
+
+    return shed_round(
+        state, snap,
+        src_need=offline_per_broker,
+        cand_score=jnp.zeros(state.num_replicas, jnp.float32),
+        cand_ok=snap.offline,
+        dst_fn=dst_fn,
+    )
+
+
+def offline_round_relaxed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    """Fallback offline repair without rack/capacity preconditions — ensures no
+    replica is stranded on a dead broker even in tight clusters (the goals then
+    re-balance); only destination aliveness and partition-uniqueness are required."""
+    offline_per_broker = jax.ops.segment_sum(
+        snap.offline.astype(jnp.float32), state.replica_broker,
+        num_segments=state.num_brokers,
+    )
+
+    def dst_fn(cand: jax.Array):
+        p = state.replica_partition[cand]
+        on_dst = jax.ops.segment_sum(
+            state.replica_valid.astype(jnp.int32),
+            state.replica_partition * state.num_brokers + state.replica_broker,
+            num_segments=state.num_partitions * state.num_brokers,
+        ).reshape(state.num_partitions, state.num_brokers)
+        dup = on_dst[p] > 0  # [S, B]
+        score = _bcast(-snap.util_pct.max(axis=-1), cand.shape[0])
+        return ~dup, score
+
+    return shed_round(
+        state, snap,
+        src_need=offline_per_broker,
+        cand_score=jnp.zeros(state.num_replicas, jnp.float32),
+        cand_ok=snap.offline,
+        dst_fn=dst_fn,
+    )
+
+
+# -- RackAwareGoal (RackAwareGoal.java:35, rebalance :152) -------------------------
+
+
+def rack_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    viol = G.rack_violating_replicas(state, snap)
+    src_need = jax.ops.segment_sum(
+        viol.astype(jnp.float32), state.replica_broker, num_segments=state.num_brokers
+    )
+
+    def dst_fn(cand: jax.Array):
+        p = state.replica_partition[cand]
+        src_rack = state.broker_rack[state.replica_broker[cand]]
+        occ = snap.rack_counts[p][:, state.broker_rack]
+        occ = occ - (src_rack[:, None] == state.broker_rack[None, :]).astype(jnp.int32)
+        score = _bcast(-_counts_f(snap), cand.shape[0])
+        return occ == 0, score
+
+    return shed_round(
+        state, snap,
+        src_need=src_need,
+        cand_score=jnp.zeros(state.num_replicas, jnp.float32),
+        cand_ok=viol & (snap.movable | snap.offline),
+        dst_fn=dst_fn,
+    )
+
+
+# -- ReplicaCapacityGoal -----------------------------------------------------------
+
+
+def replica_capacity_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    max_r = ctx.constraint.max_replicas_per_broker
+    src_need = (snap.replica_counts - max_r).astype(jnp.float32)
+
+    def dst_fn(cand: jax.Array):
+        ok = _bcast(snap.replica_counts + 1 <= max_r, cand.shape[0])
+        score = _bcast(-_counts_f(snap), cand.shape[0])
+        return ok, score
+
+    return shed_round(
+        state, snap,
+        src_need=src_need,
+        cand_score=-snap.eff_load[:, Resource.DISK],  # cheapest moves first
+        cand_ok=snap.movable,
+        dst_fn=dst_fn,
+    )
+
+
+# -- CapacityGoal family (CapacityGoal.java:41, rebalance :275) --------------------
+
+
+def _capacity_leadership_round(res: int) -> RoundFn:
+    def fn(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+        limit = snap.cap_limits[:, res]
+        src_need = snap.broker_load[:, res] - limit
+        ldelta = state.leadership_delta[state.replica_partition, res]
+        fb = state.replica_broker
+        fits = snap.broker_load[fb, res] + ldelta <= limit[fb]
+        return leadership_shed_round(
+            state, snap,
+            src_need=src_need,
+            leader_score=ldelta,
+            leader_ok=snap.movable,
+            follower_score=-snap.util_pct[fb, res],
+            follower_ok=fits & (ldelta > 0),
+        )
+
+    return fn
+
+
+def _capacity_move_round(res: int) -> RoundFn:
+    def fn(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+        limit = snap.cap_limits[:, res]
+        src_need = snap.broker_load[:, res] - limit
+        headroom = jnp.where(snap.dest_ok, limit - snap.broker_load[:, res], NEG)
+        max_headroom = jnp.max(headroom)
+        load = snap.eff_load[:, res]
+
+        def dst_fn(cand: jax.Array):
+            fits = _bcast(snap.broker_load[:, res], cand.shape[0]) + load[cand][:, None] \
+                <= _bcast(limit, cand.shape[0])
+            score = _bcast(-snap.util_pct[:, res], cand.shape[0])
+            return fits, score
+
+        return shed_round(
+            state, snap,
+            src_need=src_need,
+            cand_score=load,
+            cand_ok=snap.movable & (load <= max_headroom) & (load > 0),
+            dst_fn=dst_fn,
+        )
+
+    return fn
+
+
+# -- ReplicaDistributionGoal (:51) -------------------------------------------------
+
+
+def replica_dist_shed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    lo, up = snap.replica_band[0], snap.replica_band[1]
+    src_need = (snap.replica_counts - up).astype(jnp.float32)
+
+    def dst_fn(cand: jax.Array):
+        ok = _bcast(snap.replica_counts + 1 <= up, cand.shape[0])
+        score = _bcast(-_counts_f(snap), cand.shape[0])
+        return ok, score
+
+    return shed_round(
+        state, snap,
+        src_need=src_need,
+        cand_score=-snap.eff_load[:, Resource.DISK],
+        cand_ok=snap.movable,
+        dst_fn=dst_fn,
+    )
+
+
+def replica_dist_fill(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    lo, up = snap.replica_band[0], snap.replica_band[1]
+    dst_need = (lo - snap.replica_counts).astype(jnp.float32)
+    donor_keeps = snap.replica_counts[state.replica_broker] - 1 >= lo
+
+    def fit_fn(cand: jax.Array):
+        donor_counts = snap.replica_counts[state.replica_broker[cand]]
+        improves = donor_counts[None, :] >= snap.replica_counts[:, None] + 2
+        src_score = _bcast(donor_counts.astype(jnp.float32), state.num_brokers)
+        return improves, src_score
+
+    return fill_round(
+        state, snap,
+        dst_need=dst_need,
+        donor_score=-snap.eff_load[:, Resource.DISK],
+        donor_ok=snap.movable & donor_keeps,
+        fit_fn=fit_fn,
+    )
+
+
+# -- PotentialNwOutGoal (:42) ------------------------------------------------------
+
+
+def potential_nw_out_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    limit = snap.cap_limits[:, Resource.NW_OUT]
+    src_need = snap.potential_nw_out - limit
+    leader_nw = (
+        state.base_load[:, Resource.NW_OUT]
+        + state.leadership_delta[state.replica_partition, Resource.NW_OUT]
+    )
+    headroom = jnp.where(snap.dest_ok, limit - snap.potential_nw_out, NEG)
+    max_headroom = jnp.max(headroom)
+
+    def dst_fn(cand: jax.Array):
+        fits = _bcast(snap.potential_nw_out, cand.shape[0]) + leader_nw[cand][:, None] \
+            <= _bcast(limit, cand.shape[0])
+        cap = jnp.maximum(state.broker_capacity[:, Resource.NW_OUT], 1e-9)
+        score = _bcast(-(snap.potential_nw_out / cap), cand.shape[0])
+        return fits, score
+
+    return shed_round(
+        state, snap,
+        src_need=src_need,
+        cand_score=leader_nw,
+        cand_ok=snap.movable & (leader_nw <= max_headroom),
+        dst_fn=dst_fn,
+    )
+
+
+# -- ResourceDistributionGoal family (ResourceDistributionGoal.java:55) ------------
+
+
+def _dist_leadership_round(res: int) -> RoundFn:
+    def fn(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+        upper = snap.res_upper[:, res]
+        low = snap.low_util[res]
+        src_need = jnp.where(low, 0.0, snap.broker_load[:, res] - upper)
+        ldelta = state.leadership_delta[state.replica_partition, res]
+        fb = state.replica_broker
+        fits = snap.broker_load[fb, res] + ldelta <= upper[fb]
+        return leadership_shed_round(
+            state, snap,
+            src_need=src_need,
+            leader_score=ldelta,
+            leader_ok=snap.movable,
+            follower_score=-snap.util_pct[fb, res],
+            follower_ok=fits & (ldelta > 0),
+        )
+
+    return fn
+
+
+def _dist_shed_round(res: int) -> RoundFn:
+    def fn(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+        lower, upper = snap.res_lower[:, res], snap.res_upper[:, res]
+        low = snap.low_util[res]
+        src_need = jnp.where(low, 0.0, snap.broker_load[:, res] - upper)
+        load = snap.eff_load[:, res]
+        src_b = state.replica_broker
+        keeps_src = load <= snap.broker_load[src_b, res] - lower[src_b]
+        headroom = jnp.where(snap.dest_ok, upper - snap.broker_load[:, res], NEG)
+        max_headroom = jnp.max(headroom)
+
+        def dst_fn(cand: jax.Array):
+            fits = _bcast(snap.broker_load[:, res], cand.shape[0]) + load[cand][:, None] \
+                <= _bcast(upper, cand.shape[0])
+            score = _bcast(-snap.util_pct[:, res], cand.shape[0])
+            return fits, score
+
+        return shed_round(
+            state, snap,
+            src_need=src_need,
+            cand_score=load,
+            cand_ok=snap.movable & keeps_src & (load > 0) & (load <= max_headroom),
+            dst_fn=dst_fn,
+        )
+
+    return fn
+
+
+def _dist_fill_round(res: int) -> RoundFn:
+    def fn(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+        lower, upper = snap.res_lower[:, res], snap.res_upper[:, res]
+        low = snap.low_util[res]
+        dst_need = jnp.where(low, 0.0, lower - snap.broker_load[:, res])
+        load = snap.eff_load[:, res]
+        src_b = state.replica_broker
+        donor_keeps = load <= snap.broker_load[src_b, res] - lower[src_b]
+
+        def fit_fn(cand: jax.Array):
+            fits = snap.broker_load[:, None, res] + load[cand][None, :] <= upper[:, None]
+            src_score = _bcast(snap.util_pct[state.replica_broker[cand], res], state.num_brokers)
+            return fits, src_score
+
+        return fill_round(
+            state, snap,
+            dst_need=dst_need,
+            donor_score=load,
+            donor_ok=snap.movable & donor_keeps & (load > 0),
+            fit_fn=fit_fn,
+        )
+
+    return fn
+
+
+# -- TopicReplicaDistributionGoal --------------------------------------------------
+
+
+def topic_dist_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    bt = snap.topic_counts
+    tup = snap.topic_band[1]
+    topic = state.partition_topic[state.replica_partition]
+    excess = (bt - tup[None, :]).astype(jnp.float32)  # [B, T]
+    r_excess = excess[state.replica_broker, topic]
+    src_need = jnp.where(state.broker_alive, excess.max(axis=1), 0.0)
+
+    def dst_fn(cand: jax.Array):
+        t = topic[cand]
+        ok = bt[:, t].T + 1 <= tup[t][:, None]
+        score = -bt[:, t].T.astype(jnp.float32)
+        return ok, score
+
+    return shed_round(
+        state, snap,
+        src_need=src_need,
+        cand_score=r_excess,
+        cand_ok=snap.movable & (r_excess > 0),
+        dst_fn=dst_fn,
+    )
+
+
+# -- LeaderReplicaDistributionGoal -------------------------------------------------
+
+
+def leader_dist_shed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    lup = snap.leader_band[1]
+    src_need = (snap.leader_counts - lup).astype(jnp.float32)
+    fb = state.replica_broker
+    fits = snap.leader_counts[fb] + 1 <= lup
+    return leadership_shed_round(
+        state, snap,
+        src_need=src_need,
+        leader_score=jnp.zeros(state.num_replicas, jnp.float32),
+        leader_ok=snap.movable,
+        follower_score=-snap.leader_counts[fb].astype(jnp.float32),
+        follower_ok=fits,
+    )
+
+
+def leader_dist_fill(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    llo = snap.leader_band[0]
+    dst_need = (llo - snap.leader_counts).astype(jnp.float32)
+    p = state.replica_partition
+    cur_leader = state.partition_leader[p]
+    leader_broker = state.replica_broker[jnp.maximum(cur_leader, 0)]
+    donor_rich = snap.leader_counts[leader_broker] - 1 >= llo
+    return leadership_fill_round(
+        state, snap,
+        dst_need=dst_need,
+        follower_score=snap.leader_counts[leader_broker].astype(jnp.float32),
+        follower_ok=donor_rich & (cur_leader >= 0),
+    )
+
+
+# -- LeaderBytesInDistributionGoal (:50) -------------------------------------------
+
+
+def leader_bytes_in_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    upper = snap.leader_nw_in_upper
+    src_need = snap.leader_nw_in - upper
+    nw_in = snap.eff_load[:, Resource.NW_IN]
+    fb = state.replica_broker
+    fits = snap.leader_nw_in[fb] + nw_in <= upper
+    return leadership_shed_round(
+        state, snap,
+        src_need=src_need,
+        leader_score=nw_in,
+        leader_ok=snap.movable,
+        follower_score=-snap.leader_nw_in[fb],
+        follower_ok=fits,
+    )
+
+
+# -- MinTopicLeadersPerBrokerGoal (:52) --------------------------------------------
+
+
+def min_topic_leaders_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    lead_bt = snap.topic_leader_counts
+    need = ctx.constraint.min_topic_leaders_per_broker
+    topic = state.partition_topic[state.replica_partition]
+    protected = ctx.min_leader_topics[topic]
+    deficit = (need - lead_bt).astype(jnp.float32)  # [B, T]
+    deficit = jnp.where(ctx.min_leader_topics[None, :], deficit, 0.0)
+    deficit = jnp.where(state.broker_alive[:, None], deficit, 0.0)
+    dst_need = deficit.max(axis=1)
+
+    p = state.replica_partition
+    cur_leader = state.partition_leader[p]
+    leader_broker = state.replica_broker[jnp.maximum(cur_leader, 0)]
+    donor_spare = lead_bt[leader_broker, topic] - 1 >= need
+    r_deficit = deficit[state.replica_broker, topic]
+    return leadership_fill_round(
+        state, snap,
+        dst_need=dst_need,
+        follower_score=r_deficit,
+        follower_ok=protected & (r_deficit > 0) & donor_spare & (cur_leader >= 0),
+    )
+
+
+# -- registry ----------------------------------------------------------------------
+
+GOAL_ROUNDS: Dict[int, Tuple[RoundFn, ...]] = {
+    G.RACK_AWARE: (rack_round,),
+    G.MIN_TOPIC_LEADERS: (min_topic_leaders_round,),
+    G.REPLICA_CAPACITY: (replica_capacity_round,),
+    G.DISK_CAPACITY: (_capacity_move_round(Resource.DISK),),
+    G.NW_IN_CAPACITY: (_capacity_move_round(Resource.NW_IN),),
+    G.NW_OUT_CAPACITY: (
+        _capacity_leadership_round(Resource.NW_OUT),
+        _capacity_move_round(Resource.NW_OUT),
+    ),
+    G.CPU_CAPACITY: (
+        _capacity_leadership_round(Resource.CPU),
+        _capacity_move_round(Resource.CPU),
+    ),
+    G.REPLICA_DISTRIBUTION: (replica_dist_shed, replica_dist_fill),
+    G.POTENTIAL_NW_OUT: (potential_nw_out_round,),
+    G.DISK_USAGE_DIST: (
+        _dist_shed_round(Resource.DISK),
+        _dist_fill_round(Resource.DISK),
+    ),
+    G.NW_IN_USAGE_DIST: (
+        _dist_shed_round(Resource.NW_IN),
+        _dist_fill_round(Resource.NW_IN),
+    ),
+    G.NW_OUT_USAGE_DIST: (
+        _dist_leadership_round(Resource.NW_OUT),
+        _dist_shed_round(Resource.NW_OUT),
+        _dist_fill_round(Resource.NW_OUT),
+    ),
+    G.CPU_USAGE_DIST: (
+        _dist_leadership_round(Resource.CPU),
+        _dist_shed_round(Resource.CPU),
+        _dist_fill_round(Resource.CPU),
+    ),
+    G.TOPIC_REPLICA_DIST: (topic_dist_round,),
+    G.LEADER_REPLICA_DIST: (leader_dist_shed, leader_dist_fill),
+    G.LEADER_BYTES_IN_DIST: (leader_bytes_in_round,),
+}

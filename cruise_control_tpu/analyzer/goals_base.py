@@ -1,0 +1,186 @@
+"""Goal registry and per-goal violation counters.
+
+The reference's 29 ``Goal`` classes (``analyzer/goals/``, SPI ``Goal.java:39``) become a
+fixed registry of integer goal ids, each backed by three vectorized kernels:
+
+* ``violations``  — count of violating entities (0 ⇒ satisfied), the array analogue of
+  each goal's ``GoalState``/success criterion (this module);
+* ``acceptance``  — per-candidate-action veto (``Goal.actionAcceptance``, Goal.java:81),
+  see :mod:`cruise_control_tpu.analyzer.acceptance`;
+* ``rounds``      — batched improvement rounds, see
+  :mod:`cruise_control_tpu.analyzer.goal_rounds`.
+
+Resource-parameterized goal families (capacity, usage distribution) get one id per
+resource so the lexicographic priority list stays a flat sequence, mirroring the
+default priority order in ``config/constants/AnalyzerConfig.java:352-368``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import GoalContext, Snapshot
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.model.arrays import ClusterArrays
+
+# -- goal ids (priority-list members) ---------------------------------------------
+
+RACK_AWARE = 0
+MIN_TOPIC_LEADERS = 1
+REPLICA_CAPACITY = 2
+DISK_CAPACITY = 3
+NW_IN_CAPACITY = 4
+NW_OUT_CAPACITY = 5
+CPU_CAPACITY = 6
+REPLICA_DISTRIBUTION = 7
+POTENTIAL_NW_OUT = 8
+DISK_USAGE_DIST = 9
+NW_IN_USAGE_DIST = 10
+NW_OUT_USAGE_DIST = 11
+CPU_USAGE_DIST = 12
+TOPIC_REPLICA_DIST = 13
+LEADER_REPLICA_DIST = 14
+LEADER_BYTES_IN_DIST = 15
+NUM_GOALS = 16
+
+GOAL_NAMES: Tuple[str, ...] = (
+    "RackAwareGoal",
+    "MinTopicLeadersPerBrokerGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+)
+GOAL_ID_BY_NAME: Dict[str, int] = {n: i for i, n in enumerate(GOAL_NAMES)}
+
+#: Goals needing [B, T] tensors — skipped at scale unless explicitly enabled.
+HEAVY_GOALS: Tuple[int, ...] = (MIN_TOPIC_LEADERS, TOPIC_REPLICA_DIST)
+
+#: Default ``hard.goals`` (AnalyzerConfig.java:337-344).
+HARD_GOALS: Tuple[int, ...] = (
+    RACK_AWARE,
+    MIN_TOPIC_LEADERS,
+    REPLICA_CAPACITY,
+    DISK_CAPACITY,
+    NW_IN_CAPACITY,
+    NW_OUT_CAPACITY,
+    CPU_CAPACITY,
+)
+
+#: Default goal priority order (AnalyzerConfig.java:352-368, DEFAULT_DEFAULT_GOALS).
+DEFAULT_GOAL_ORDER: Tuple[int, ...] = tuple(range(NUM_GOALS))
+
+CAPACITY_RESOURCE: Dict[int, int] = {
+    DISK_CAPACITY: Resource.DISK,
+    NW_IN_CAPACITY: Resource.NW_IN,
+    NW_OUT_CAPACITY: Resource.NW_OUT,
+    CPU_CAPACITY: Resource.CPU,
+}
+DIST_RESOURCE: Dict[int, int] = {
+    DISK_USAGE_DIST: Resource.DISK,
+    NW_IN_USAGE_DIST: Resource.NW_IN,
+    NW_OUT_USAGE_DIST: Resource.NW_OUT,
+    CPU_USAGE_DIST: Resource.CPU,
+}
+
+
+# -- rack-awareness helpers --------------------------------------------------------
+
+
+def rack_violating_replicas(state: ClusterArrays, snap: Snapshot) -> jax.Array:
+    """bool[R]: replicas that must move for rack uniqueness (RackAwareGoal.java:35).
+
+    For each (partition, rack) group with >1 replica, every member except the
+    group's first (lowest replica index) is violating.  Offline replicas are always
+    violating.
+    """
+    rack = state.broker_rack[state.replica_broker]
+    group = state.replica_partition * state.num_racks + rack
+    n_groups = state.num_partitions * state.num_racks
+    ones = state.replica_valid.astype(jnp.int32)
+    group_size = jax.ops.segment_sum(ones, group, num_segments=n_groups)
+    idx = jnp.arange(state.num_replicas, dtype=jnp.int32)
+    big = jnp.int32(2**30)
+    first = jax.ops.segment_min(
+        jnp.where(state.replica_valid, idx, big), group, num_segments=n_groups
+    )
+    crowded = (group_size[group] > 1) & (idx != first[group]) & state.replica_valid
+    return crowded | snap.offline
+
+
+# -- violations -------------------------------------------------------------------
+
+
+def violations_all(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> jax.Array:
+    """f32[NUM_GOALS]: violating-entity count per goal id (0 ⇒ goal satisfied).
+
+    The heavy [B, T] goals report 0 when the snapshot was taken without
+    ``enable_heavy``.
+    """
+    out = jnp.zeros(NUM_GOALS, jnp.float32)
+    alive = state.broker_alive
+
+    out = out.at[RACK_AWARE].set(rack_violating_replicas(state, snap).sum())
+
+    counts = snap.replica_counts
+    out = out.at[REPLICA_CAPACITY].set(
+        ((counts > ctx.constraint.max_replicas_per_broker) & alive).sum()
+    )
+
+    over_cap = (snap.broker_load > snap.cap_limits * (1 + 1e-6) + 1e-6) & alive[:, None]
+    for gid, res in CAPACITY_RESOURCE.items():
+        out = out.at[gid].set(over_cap[:, res].sum())
+
+    lo, up = snap.replica_band[0], snap.replica_band[1]
+    out = out.at[REPLICA_DISTRIBUTION].set(
+        (((counts > up) | (counts < lo)) & alive).sum()
+    )
+
+    pnw_limit = snap.cap_limits[:, Resource.NW_OUT]
+    out = out.at[POTENTIAL_NW_OUT].set(
+        ((snap.potential_nw_out > pnw_limit * (1 + 1e-6) + 1e-6) & alive).sum()
+    )
+
+    eps = 1e-6
+    outside = (snap.broker_load > snap.res_upper * (1 + eps) + eps) | (
+        snap.broker_load < snap.res_lower * (1 - eps) - eps
+    )
+    outside = outside & alive[:, None] & ~snap.low_util[None, :]
+    for gid, res in DIST_RESOURCE.items():
+        out = out.at[gid].set(outside[:, res].sum())
+
+    llo, lup = snap.leader_band[0], snap.leader_band[1]
+    lcounts = snap.leader_counts
+    out = out.at[LEADER_REPLICA_DIST].set(
+        (((lcounts > lup) | (lcounts < llo)) & alive).sum()
+    )
+
+    out = out.at[LEADER_BYTES_IN_DIST].set(
+        ((snap.leader_nw_in > snap.leader_nw_in_upper * (1 + eps) + eps) & alive).sum()
+    )
+
+    if snap.enable_heavy:
+        bt = snap.topic_counts
+        tup = snap.topic_band[1]
+        t_over = (bt > tup[None, :]) & alive[:, None]
+        out = out.at[TOPIC_REPLICA_DIST].set(t_over.sum())
+
+        need = ctx.constraint.min_topic_leaders_per_broker
+        deficit = jnp.maximum(0, need - snap.topic_leader_counts) * ctx.min_leader_topics[None, :]
+        deficit = jnp.where(alive[:, None], deficit, 0)
+        out = out.at[MIN_TOPIC_LEADERS].set(deficit.sum())
+
+    return out

@@ -1,0 +1,196 @@
+"""Batched balancing actions.
+
+Counterpart of ``analyzer/BalancingAction.java`` / ``ActionType.java:23-28``, array-
+first: a :class:`MoveBatch` is a fixed-shape batch of K candidate actions (one slot per
+source broker in the round engine), where invalid slots carry ``replica == -1``.  The
+optimizer evaluates acceptance over the whole batch at once, resolves conflicts by
+deduplication (at most one action per destination broker and per partition per round —
+the parallel-greedy analogue of the reference's strictly sequential
+``maybeApplyBalancingAction``), and applies survivors as one scatter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cruise_control_tpu.model import arrays as A
+from cruise_control_tpu.model.arrays import ClusterArrays
+
+# ActionType (ActionType.java:23-28). Intra-broker variants arrive with JBOD goals.
+KIND_REPLICA_MOVE = 0
+KIND_LEADERSHIP = 1
+KIND_SWAP = 2
+
+
+@struct.dataclass
+class MoveBatch:
+    """K candidate actions of a single kind (slots with replica < 0 are no-ops)."""
+
+    kind: jax.Array         # i32 scalar — KIND_* for the whole batch
+    replica: jax.Array      # i32[K] source replica (for LEADERSHIP: current leader)
+    dst_broker: jax.Array   # i32[K] destination broker
+    dst_replica: jax.Array  # i32[K] swap partner / new leader replica; -1 otherwise
+    score: jax.Array        # f32[K] priority used for conflict dedup (higher wins)
+
+    @property
+    def num_slots(self) -> int:
+        return self.replica.shape[0]
+
+    @property
+    def valid(self) -> jax.Array:
+        return self.replica >= 0
+
+    @classmethod
+    def empty(cls, k: int, kind: int) -> "MoveBatch":
+        return cls(
+            kind=jnp.asarray(kind, jnp.int32),
+            replica=jnp.full(k, -1, jnp.int32),
+            dst_broker=jnp.full(k, -1, jnp.int32),
+            dst_replica=jnp.full(k, -1, jnp.int32),
+            score=jnp.zeros(k, jnp.float32),
+        )
+
+
+@struct.dataclass
+class MoveEffects:
+    """Per-slot state deltas, precomputed once and shared by all acceptance kernels."""
+
+    src_broker: jax.Array   # i32[K]
+    dst_broker: jax.Array   # i32[K]
+    partition: jax.Array    # i32[K]
+    delta_src: jax.Array    # f32[K, 4] load change on the source broker
+    delta_dst: jax.Array    # f32[K, 4] load change on the destination broker
+    count_delta: jax.Array       # i32[K] replica-count change at dst (+1 move, 0 other)
+    leader_delta_src: jax.Array  # i32[K] leader-count change at src
+    leader_delta_dst: jax.Array  # i32[K] leader-count change at dst
+    valid: jax.Array        # bool[K]
+
+
+def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
+    """Compute the per-broker load/count deltas of each candidate action.
+
+    Leadership retention matters: a moved replica keeps (or carries) its leadership,
+    so its *effective* load — base + is_leader·delta (arrays.py) — is what travels in
+    a replica move or swap, exactly like the reference moves the replica's whole
+    ``Load`` (ClusterModel.relocateReplica:380) and transfers the leadership share on
+    relocateLeadership (:409).
+    """
+    ok = moves.replica >= 0
+    r = jnp.where(ok, moves.replica, 0)
+    eff = A.effective_load(state)
+    p = state.replica_partition[r]
+    src = state.replica_broker[r]
+
+    kind = moves.kind
+    is_move = kind == KIND_REPLICA_MOVE
+    is_lead = kind == KIND_LEADERSHIP
+    is_swap = kind == KIND_SWAP
+
+    rb = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+    ldelta = state.leadership_delta[p]
+
+    move_src = -eff[r]
+    move_dst = eff[r]
+    lead_src = -ldelta
+    lead_dst = ldelta
+    swap_src = eff[rb] - eff[r]
+    swap_dst = eff[r] - eff[rb]
+
+    delta_src = jnp.where(is_move, move_src, jnp.where(is_lead, lead_src, swap_src))
+    delta_dst = jnp.where(is_move, move_dst, jnp.where(is_lead, lead_dst, swap_dst))
+
+    lead = A.is_leader(state)
+    r_leads = lead[r]
+    rb_leads = lead[rb] & (moves.dst_replica >= 0)
+    # replica move: leader count follows the replica; leadership: -1/+1; swap: net swap
+    lsrc = jnp.where(
+        is_move,
+        -r_leads.astype(jnp.int32),
+        jnp.where(is_lead, -1, rb_leads.astype(jnp.int32) - r_leads.astype(jnp.int32)),
+    )
+    ldst = -lsrc
+    cnt = jnp.where(is_move, 1, 0)
+
+    z = jnp.int32(0)
+    return MoveEffects(
+        src_broker=src,
+        dst_broker=jnp.where(ok, moves.dst_broker, 0),
+        partition=p,
+        delta_src=jnp.where(ok[:, None], delta_src, 0.0),
+        delta_dst=jnp.where(ok[:, None], delta_dst, 0.0),
+        count_delta=jnp.where(ok, cnt, z),
+        leader_delta_src=jnp.where(ok, lsrc, z),
+        leader_delta_dst=jnp.where(ok, ldst, z),
+        valid=ok,
+    )
+
+
+def _keep_best_per_key(
+    keep: jax.Array, key: jax.Array, score: jax.Array, num_keys: int
+) -> jax.Array:
+    """bool[K]: among slots with equal ``key``, keep only the highest-score one."""
+    neg = jnp.float32(-3e38)
+    k = jnp.where(keep, key, 0)
+    s = jnp.where(keep, score, neg)
+    best = jax.ops.segment_max(s, k, num_segments=num_keys)
+    hit = keep & (s >= best[k]) & (s > neg / 2)
+    # break exact-score ties by slot index (lowest wins) for determinism
+    idx = jnp.arange(key.shape[0], dtype=jnp.int32)
+    big = jnp.int32(2**30)
+    cand = jnp.where(hit, idx, big)
+    first = jax.ops.segment_min(cand, k, num_segments=num_keys)
+    return hit & (idx == first[k])
+
+
+def resolve_conflicts(
+    state: ClusterArrays,
+    moves: MoveBatch,
+    accepted: jax.Array,
+    eff: "MoveEffects | None" = None,
+) -> jax.Array:
+    """bool[K]: conflict-free subset of accepted slots, best-score-first.
+
+    Guarantees per round: ≤1 action per destination broker and per source broker
+    (so per-endpoint acceptance checks evaluated against the pre-round state remain
+    valid after the whole batch is applied — fill-type rounds emit one slot per
+    *destination*, so several could otherwise drain one source at once) and ≤1
+    action per partition (so partition-level invariants — rack-awareness, single
+    leader — can't be broken by two simultaneously-applied actions).
+    """
+    if eff is None:
+        eff = move_effects(state, moves)
+    keep = accepted & eff.valid
+    keep = _keep_best_per_key(keep, eff.partition, moves.score, state.num_partitions)
+    keep = _keep_best_per_key(keep, eff.dst_broker, moves.score, state.num_brokers)
+    keep = _keep_best_per_key(keep, eff.src_broker, moves.score, state.num_brokers)
+    # swaps touch the destination *replica*'s partition too — serialize on it as well
+    is_swap = moves.kind == KIND_SWAP
+    dst_part = state.replica_partition[jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)]
+
+    def _swap_dedup(keep):
+        return _keep_best_per_key(keep, dst_part, moves.score, state.num_partitions)
+
+    keep = jax.lax.cond(is_swap, _swap_dedup, lambda k: k, keep)
+    return keep
+
+
+def apply_moves(state: ClusterArrays, moves: MoveBatch, keep: jax.Array) -> ClusterArrays:
+    """Apply the surviving slots as batched scatters (fixed shape, jit-safe)."""
+    sel = jnp.where(keep, moves.replica, -1)
+
+    def _apply_replica_move(state):
+        return A.relocate_replicas(state, sel, moves.dst_broker)
+
+    def _apply_leadership(state):
+        p = jnp.where(sel >= 0, state.replica_partition[jnp.maximum(sel, 0)], -1)
+        return A.relocate_leadership(state, p, moves.dst_replica)
+
+    def _apply_swap(state):
+        partner = jnp.where(keep, moves.dst_replica, -1)
+        return A.swap_replicas(state, sel, partner)
+
+    return jax.lax.switch(
+        moves.kind, [_apply_replica_move, _apply_leadership, _apply_swap], state
+    )

@@ -1,0 +1,238 @@
+"""Generic batched round proposers: shed, fill, and leadership transfer.
+
+The reference's ``AbstractGoal.optimize`` walks brokers sequentially, and per broker
+walks ``SortedReplicas`` candidates, applying one action at a time
+(AbstractGoal.java:82-135).  The TPU formulation turns one sweep into a *round*: every
+source broker simultaneously nominates its best candidate replica (a segment-argmax —
+the array analogue of the sorted-replica walk), every candidate picks its best eligible
+destination (a masked row argmax), and the optimizer applies the conflict-free subset.
+Rounds repeat until no action survives, which plays the role of ``_finished``.
+
+All proposers return a :class:`MoveBatch` with one slot per broker.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import NEG, GoalContext, Snapshot, segment_argmax
+from cruise_control_tpu.analyzer.moves import (
+    KIND_LEADERSHIP,
+    KIND_REPLICA_MOVE,
+    MoveBatch,
+)
+from cruise_control_tpu.model.arrays import ClusterArrays
+
+# dst_fn(cand_replica i32[B]) -> (eligible bool[B, B], score f32[B, B]); row = source
+# broker slot, column = destination broker.
+DstFn = Callable[[jax.Array], Tuple[jax.Array, jax.Array]]
+
+#: Tie-break magnitude for destination choice.  Must stay below meaningful score
+#: differences (counts differ by ≥1; util fractions by ≫1e-4 when it matters).
+TIEBREAK = jnp.float32(1e-4)
+
+
+def _pair_jitter(a: jax.Array, b: jax.Array) -> jax.Array:
+    """f32 in (-TIEBREAK, 0]: deterministic jitter from an (a, b) index pair
+    (broadcasting); shared by every proposer's tie-breaking."""
+    h = a * jnp.int32(1103515245) + b * jnp.int32(40503)
+    h = jnp.bitwise_and(h ^ (h >> 7), jnp.int32(1023))
+    return -TIEBREAK * h.astype(jnp.float32) / 1024.0
+
+
+def _cyclic_tiebreak(num_rows: int, num_cols: int, row_ids: jax.Array) -> jax.Array:
+    """f32[rows, cols] in (-TIEBREAK, 0]: per-(row, col) jitter so equal-scored
+    destinations spread across sources — without this, every source picks the same
+    "best" destination and per-destination conflict dedup serializes the whole
+    round to one action.  A plain cyclic offset is not enough (contiguous source
+    blocks all prefer the same first eligible column), hence the hash.
+    """
+    cols = jnp.arange(num_cols, dtype=jnp.int32)[None, :]
+    return _pair_jitter(row_ids[:, None], cols)
+
+
+def _partition_occupancy(
+    state: ClusterArrays, cand: jax.Array, cand_valid: jax.Array
+) -> jax.Array:
+    """bool[S, B]: does candidate s's partition already have a replica on broker b?
+
+    Brokers may host at most one replica of a partition (a Kafka invariant, not a
+    goal) — enforced here for every replica-move round so it holds under *any*
+    goal list, not just when RackAwareGoal's acceptance kernel is active.
+    Cost: one scatter over R plus an [S, B] gather; no [P, B] materialization.
+
+    Returns ``occupied | ~unique``: slots whose partition lost the inverse-map
+    race (two candidates sharing a partition) are fully masked — they simply sit
+    this round out and retry next round.
+    """
+    S = cand.shape[0]
+    # slot_of_partition: P-sized inverse map, -1 for non-candidate partitions.
+    # Invalid slots scatter out of bounds (dropped) so they claim no partition.
+    p_oob = jnp.int32(state.num_partitions)
+    p_cand = jnp.where(cand_valid, state.replica_partition[cand], p_oob)
+    slot = jnp.full(state.num_partitions, -1, jnp.int32)
+    slot = slot.at[p_cand].set(jnp.arange(S, dtype=jnp.int32), mode="drop")
+    p_safe = jnp.where(cand_valid, p_cand, 0)
+    unique = cand_valid & (slot[p_safe] == jnp.arange(S, dtype=jnp.int32))
+    # scatter every live replica into (slot, broker) occupancy
+    r_slot = slot[state.replica_partition]
+    occupied = jnp.zeros((S, state.num_brokers), bool)
+    oob = jnp.int32(S)
+    rows = jnp.where((r_slot >= 0) & state.replica_valid, r_slot, oob)
+    occupied = occupied.at[rows, state.replica_broker].set(True, mode="drop")
+    return occupied | ~unique[:, None]
+
+
+def shed_round(
+    state: ClusterArrays,
+    snap: Snapshot,
+    src_need: jax.Array,     # f32[B] > 0 ⇒ broker must shed
+    cand_score: jax.Array,   # f32[R] preference among its broker's replicas
+    cand_ok: jax.Array,      # bool[R]
+    dst_fn: DstFn,
+) -> MoveBatch:
+    """One replica-move round pushing load out of violating brokers."""
+    B = state.num_brokers
+    active = src_need > 0
+    cand = segment_argmax(cand_score, state.replica_broker, B, cand_ok)
+    valid = active & (cand >= 0)
+    cand_safe = jnp.where(cand >= 0, cand, 0)
+
+    elig, score = dst_fn(cand_safe)
+    cols = jnp.arange(B, dtype=jnp.int32)
+    not_self = cols[None, :] != state.replica_broker[cand_safe][:, None]
+    elig = elig & snap.dest_ok[None, :] & not_self & valid[:, None]
+    elig = elig & ~_partition_occupancy(state, cand_safe, cand >= 0)
+    score = score + _cyclic_tiebreak(B, B, cols)
+    score = jnp.where(elig, score, NEG)
+    dst = jnp.argmax(score, axis=1).astype(jnp.int32)
+    found = jnp.take_along_axis(score, dst[:, None], axis=1)[:, 0] > NEG / 2
+
+    replica = jnp.where(valid & found, cand_safe, -1)
+    return MoveBatch(
+        kind=jnp.asarray(KIND_REPLICA_MOVE, jnp.int32),
+        replica=replica,
+        dst_broker=jnp.where(replica >= 0, dst, -1),
+        dst_replica=jnp.full(B, -1, jnp.int32),
+        score=jnp.where(replica >= 0, src_need, 0.0),
+    )
+
+
+def fill_round(
+    state: ClusterArrays,
+    snap: Snapshot,
+    dst_need: jax.Array,      # f32[B] > 0 ⇒ broker wants load in
+    donor_score: jax.Array,   # f32[R] preference among a donor broker's replicas
+    donor_ok: jax.Array,      # bool[R]
+    fit_fn: Callable[[jax.Array], Tuple[jax.Array, jax.Array]],
+    # fit_fn(cand i32[B]) -> (fits bool[Bdst, Bsrc], src_score f32[Bdst, Bsrc])
+) -> MoveBatch:
+    """One replica-move round pulling load into under-limit brokers.
+
+    Mirrors the move-in direction of ``ResourceDistributionGoal.rebalanceForBroker``
+    (:380-435): each needy broker picks the best donor broker's top candidate.
+    """
+    B = state.num_brokers
+    active = dst_need > 0
+    cand = segment_argmax(donor_score, state.replica_broker, B, donor_ok)
+    cand_safe = jnp.where(cand >= 0, cand, 0)
+
+    fits, sscore = fit_fn(cand_safe)   # rows = destination, cols = donor broker
+    cols = jnp.arange(B, dtype=jnp.int32)
+    has_cand = (cand >= 0)[None, :]
+    not_self = cols[None, :] != cols[:, None]
+    dst_is_ok = (snap.dest_ok & active)[:, None]
+    fits = fits & has_cand & not_self & dst_is_ok
+    # rows = destination broker, so transpose the per-candidate occupancy
+    fits = fits & ~_partition_occupancy(state, cand_safe, cand >= 0).T
+    sscore = sscore + _cyclic_tiebreak(B, B, cols)
+    sscore = jnp.where(fits, sscore, NEG)
+    donor = jnp.argmax(sscore, axis=1).astype(jnp.int32)
+    found = jnp.take_along_axis(sscore, donor[:, None], axis=1)[:, 0] > NEG / 2
+
+    replica = jnp.where(active & found, cand_safe[donor], -1)
+    return MoveBatch(
+        kind=jnp.asarray(KIND_REPLICA_MOVE, jnp.int32),
+        replica=replica,
+        dst_broker=jnp.where(replica >= 0, cols, -1),
+        dst_replica=jnp.full(B, -1, jnp.int32),
+        score=jnp.where(replica >= 0, dst_need, 0.0),
+    )
+
+
+def leadership_shed_round(
+    state: ClusterArrays,
+    snap: Snapshot,
+    src_need: jax.Array,       # f32[B] > 0 ⇒ broker must shed leadership load
+    leader_score: jax.Array,   # f32[R] preference among the broker's leader replicas
+    leader_ok: jax.Array,      # bool[R] leader may surrender leadership
+    follower_score: jax.Array,  # f32[R] preference among takeover candidates
+    follower_ok: jax.Array,    # bool[R] replica may take leadership
+) -> MoveBatch:
+    """One leadership-transfer round (the "leadership movement first" phase of
+    NW_OUT/CPU balancing, ResourceDistributionGoal.java:380)."""
+    B, P = state.num_brokers, state.num_partitions
+    take_ok = (
+        follower_ok & snap.leader_movable & ~snap.is_leader
+        & snap.topic_allowed & state.replica_valid
+    )
+    # per-partition jitter among equal-scored takeover brokers — otherwise every
+    # partition promotes a follower on the same broker and per-destination dedup
+    # serializes the round (see _cyclic_tiebreak)
+    fb = state.replica_broker
+    tb = _pair_jitter(state.replica_partition, fb)
+    best_follower = segment_argmax(follower_score + tb, state.replica_partition, P, take_ok)
+
+    has_follower = best_follower[state.replica_partition] >= 0
+    give_ok = leader_ok & snap.is_leader & has_follower
+    cand = segment_argmax(leader_score, state.replica_broker, B, give_ok)
+    active = src_need > 0
+    valid = active & (cand >= 0)
+    cand_safe = jnp.where(cand >= 0, cand, 0)
+    p = state.replica_partition[cand_safe]
+    dst_rep = best_follower[p]
+    dst_rep_safe = jnp.where(dst_rep >= 0, dst_rep, 0)
+
+    replica = jnp.where(valid & (dst_rep >= 0), cand_safe, -1)
+    return MoveBatch(
+        kind=jnp.asarray(KIND_LEADERSHIP, jnp.int32),
+        replica=replica,
+        dst_broker=jnp.where(replica >= 0, state.replica_broker[dst_rep_safe], -1),
+        dst_replica=jnp.where(replica >= 0, dst_rep, -1),
+        score=jnp.where(replica >= 0, src_need, 0.0),
+    )
+
+
+def leadership_fill_round(
+    state: ClusterArrays,
+    snap: Snapshot,
+    dst_need: jax.Array,       # f32[B] > 0 ⇒ broker wants more leadership
+    follower_score: jax.Array,  # f32[R] preference among the broker's followers
+    follower_ok: jax.Array,    # bool[R] follower may take leadership *here*
+) -> MoveBatch:
+    """One leadership round pulling leadership onto needy brokers: each needy broker
+    promotes one of its own followers (whose current leader sits elsewhere)."""
+    B = state.num_brokers
+    take_ok = (
+        follower_ok & snap.leader_movable & ~snap.is_leader
+        & snap.topic_allowed & state.replica_valid
+    )
+    cand = segment_argmax(follower_score, state.replica_broker, B, take_ok)
+    active = dst_need > 0
+    valid = active & (cand >= 0)
+    cand_safe = jnp.where(cand >= 0, cand, 0)
+    p = state.replica_partition[cand_safe]
+    cur_leader = state.partition_leader[p]
+    ok = valid & (cur_leader >= 0)
+
+    replica = jnp.where(ok, cur_leader, -1)   # the leader surrendering
+    return MoveBatch(
+        kind=jnp.asarray(KIND_LEADERSHIP, jnp.int32),
+        replica=replica,
+        dst_broker=jnp.where(ok, jnp.arange(B, dtype=jnp.int32), -1),
+        dst_replica=jnp.where(ok, cand_safe, -1),
+        score=jnp.where(ok, dst_need, 0.0),
+    )

@@ -1,0 +1,30 @@
+"""Cluster backend SPI + in-process fake backend.
+
+The reference hides every interaction with the managed Kafka cluster behind
+AdminClient/Consumer calls (``executor/ExecutionUtils.java:435,485``,
+``monitor/sampling/CruiseControlMetricsReporterSampler.java``).  This package is the
+TPU framework's equivalent seam: :class:`ClusterBackend` is the narrow interface the
+monitor, executor and detector layers talk to, and :class:`FakeClusterBackend` is the
+in-process stand-in used by tests and demos (the role the reference's
+``CCEmbeddedBroker``/``CCKafkaIntegrationTestHarness`` play, SURVEY §4 tier 4).
+"""
+
+from cruise_control_tpu.backend.base import (
+    BrokerInfo,
+    ClusterBackend,
+    ClusterDescription,
+    LogdirInfo,
+    PartitionInfo,
+    RawMetric,
+)
+from cruise_control_tpu.backend.fake import FakeClusterBackend
+
+__all__ = [
+    "BrokerInfo",
+    "ClusterBackend",
+    "ClusterDescription",
+    "LogdirInfo",
+    "PartitionInfo",
+    "RawMetric",
+    "FakeClusterBackend",
+]

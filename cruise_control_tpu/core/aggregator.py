@@ -139,6 +139,7 @@ class MetricSampleAggregator(Generic[E]):
         self._entity_group: Dict[E, Hashable] = {}
         self._generation = 0
         self._current_window: int = -1
+        self._first_window: int = 0  # first window that ever received a sample
         self._lock = threading.RLock()
 
         strategies = metric_def.strategies_array()
@@ -243,11 +244,14 @@ class MetricSampleAggregator(Generic[E]):
         The range is contiguous: windows that received no samples (never stamped
         into the ring) are still listed — they aggregate as empty, so adjacency in
         the output equals adjacency in time and completeness counts the gaps.
+        The range never extends before the first window that ever saw a sample:
+        wall-clock start times would otherwise manufacture phantom pre-start
+        windows that invalidate every entity until a full ring elapses.
         """
         with self._lock:
             if self._current_window < 0:
                 return []
-            lo = max(0, self._current_window - self.num_windows)
+            lo = max(self._first_window, self._current_window - self.num_windows)
             return list(range(lo, self._current_window))
 
     def aggregate(
@@ -327,6 +331,8 @@ class MetricSampleAggregator(Generic[E]):
         A jump larger than the ring wraps every slot at most once, so work is
         bounded by the ring size regardless of the timestamp gap.
         """
+        if self._current_window < 0:
+            self._first_window = new_current
         gap = new_current - self._current_window
         if gap >= self._ring:
             self._win_id[:] = -1

@@ -1,0 +1,56 @@
+"""Executor layer: 3-phase proposal execution against the cluster backend.
+
+Counterpart of ``cruise-control/src/main/java/.../executor/`` (SURVEY §2.3).
+"""
+
+from cruise_control_tpu.executor.concurrency import (
+    ConcurrencyAdjuster,
+    ConcurrencyConfig,
+    ExecutionConcurrencyManager,
+)
+from cruise_control_tpu.executor.engine import (
+    ExecutionSummary,
+    Executor,
+    ExecutorNotifier,
+    ExecutorState,
+    OngoingExecutionError,
+)
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategy import (
+    BaseReplicaMovementStrategy,
+    PostponeUrpReplicaMovementStrategy,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeMinIsrWithOfflineReplicasStrategy,
+    PrioritizeOneAboveMinIsrWithOfflineReplicasStrategy,
+    PrioritizeSmallReplicaMovementStrategy,
+    ReplicaMovementStrategy,
+    StrategyContext,
+    chain_strategies,
+)
+from cruise_control_tpu.executor.tasks import ExecutionTask, TaskState, TaskType
+from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
+
+__all__ = [
+    "BaseReplicaMovementStrategy",
+    "ConcurrencyAdjuster",
+    "ConcurrencyConfig",
+    "ExecutionConcurrencyManager",
+    "ExecutionSummary",
+    "ExecutionTask",
+    "ExecutionTaskPlanner",
+    "Executor",
+    "ExecutorNotifier",
+    "ExecutorState",
+    "OngoingExecutionError",
+    "PostponeUrpReplicaMovementStrategy",
+    "PrioritizeLargeReplicaMovementStrategy",
+    "PrioritizeMinIsrWithOfflineReplicasStrategy",
+    "PrioritizeOneAboveMinIsrWithOfflineReplicasStrategy",
+    "PrioritizeSmallReplicaMovementStrategy",
+    "ReplicaMovementStrategy",
+    "ReplicationThrottleHelper",
+    "StrategyContext",
+    "TaskState",
+    "TaskType",
+    "chain_strategies",
+]

@@ -1,0 +1,328 @@
+"""Executor: applies proposals to the cluster in three phases.
+
+Counterpart of ``executor/Executor.java:84`` (``executeProposals``:810, phase logic
+``execute``:1442-1503): **inter-broker moves → intra-broker (logdir) moves →
+leadership moves**, each driven by a progress-check loop against the backend, under
+per-broker/cluster concurrency caps with auto-adjustment, replication throttles set
+for the duration, partition sampling paused during inter-broker movement
+(``adjustSamplingModeBeforeExecution``:1414), and a stop signal that aborts pending
+tasks (STOP_PROPOSAL_EXECUTION).  One execution at a time
+(``_noOngoingExecutionSemaphore``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.backend.base import ClusterBackend
+from cruise_control_tpu.executor.concurrency import (
+    ConcurrencyAdjuster,
+    ConcurrencyConfig,
+    ExecutionConcurrencyManager,
+)
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy, StrategyContext
+from cruise_control_tpu.executor.tasks import ExecutionTask, TaskState, TaskType
+from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
+
+
+class ExecutorState:
+    NO_TASK_IN_PROGRESS = "NO_TASK_IN_PROGRESS"
+    STARTING_EXECUTION = "STARTING_EXECUTION"
+    INTER_BROKER_REPLICA_MOVEMENT = "INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    INTRA_BROKER_REPLICA_MOVEMENT = "INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    LEADER_MOVEMENT = "LEADER_MOVEMENT_TASK_IN_PROGRESS"
+    STOPPING_EXECUTION = "STOPPING_EXECUTION"
+
+
+class ExecutorNotifier:
+    """ExecutorNotifier SPI (ExecutorNotifier.java); default is a no-op."""
+
+    def on_execution_finished(self, summary: "ExecutionSummary") -> None:  # pragma: no cover
+        pass
+
+
+@dataclasses.dataclass
+class ExecutionSummary:
+    execution_id: int
+    stopped: bool
+    completed: int
+    dead: int
+    aborted: int
+    duration_s: float
+
+    @property
+    def succeeded(self) -> bool:
+        return not self.stopped and self.dead == 0 and self.aborted == 0
+
+
+class OngoingExecutionError(Exception):
+    """An execution is already in progress (Executor.executeProposals rejects)."""
+
+
+class Executor:
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        concurrency: Optional[ConcurrencyConfig] = None,
+        strategies: Sequence[ReplicaMovementStrategy] = (),
+        throttle_rate_bytes: Optional[float] = None,
+        progress_check_interval_s: float = 0.05,
+        max_progress_checks: int = 10_000,
+        notifier: Optional[ExecutorNotifier] = None,
+        pause_sampling: Optional[Callable[[str], None]] = None,
+        resume_sampling: Optional[Callable[[str], None]] = None,
+        min_insync_replicas: int = 1,
+    ) -> None:
+        self.min_insync_replicas = min_insync_replicas
+        self.backend = backend
+        self.concurrency = ExecutionConcurrencyManager(concurrency or ConcurrencyConfig())
+        self.adjuster = ConcurrencyAdjuster(self.concurrency)
+        self.strategies = list(strategies)
+        self.throttle_rate_bytes = throttle_rate_bytes
+        self.progress_check_interval_s = progress_check_interval_s
+        self.max_progress_checks = max_progress_checks
+        self.notifier = notifier or ExecutorNotifier()
+        self._pause_sampling = pause_sampling
+        self._resume_sampling = resume_sampling
+
+        self._state = ExecutorState.NO_TASK_IN_PROGRESS
+        self._stop_signal = threading.Event()
+        self._lock = threading.Lock()
+        self._execution_thread: Optional[threading.Thread] = None
+        self._execution_ids = iter(range(1, 1 << 31))
+        self._last_summary: Optional[ExecutionSummary] = None
+        self._planner: Optional[ExecutionTaskPlanner] = None
+
+    # -- public API ----------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def has_ongoing_execution(self) -> bool:
+        return self._execution_thread is not None and self._execution_thread.is_alive()
+
+    @property
+    def last_summary(self) -> Optional[ExecutionSummary]:
+        return self._last_summary
+
+    def execute_proposals(
+        self,
+        proposals: Sequence[ExecutionProposal],
+        strategy_ctx: Optional[StrategyContext] = None,
+        wait: bool = True,
+    ) -> ExecutionSummary:
+        """Run the 3-phase execution; rejects when one is ongoing
+        (Executor.java:810 synchronized semantics)."""
+        with self._lock:
+            if self.has_ongoing_execution:
+                raise OngoingExecutionError("an execution is already in progress")
+            self._stop_signal.clear()
+            self._state = ExecutorState.STARTING_EXECUTION
+            planner = ExecutionTaskPlanner(self.strategies, strategy_ctx)
+            planner.add_proposals(list(proposals))
+            self._planner = planner
+            execution_id = next(self._execution_ids)
+            self._execution_thread = threading.Thread(
+                target=self._run_execution, args=(execution_id, planner), daemon=True
+            )
+            self._execution_thread.start()
+        if wait:
+            self._execution_thread.join()
+            assert self._last_summary is not None
+            return self._last_summary
+        return ExecutionSummary(execution_id, False, 0, 0, 0, 0.0)
+
+    def stop_execution(self) -> None:
+        """STOP_PROPOSAL_EXECUTION endpoint (sets ``_stopSignal``)."""
+        self._state = ExecutorState.STOPPING_EXECUTION
+        self._stop_signal.set()
+
+    def await_completion(self, timeout_s: float = 60.0) -> Optional[ExecutionSummary]:
+        t = self._execution_thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+        return self._last_summary
+
+    # -- execution phases ----------------------------------------------------
+
+    def _run_execution(self, execution_id: int, planner: ExecutionTaskPlanner) -> None:
+        t0 = time.monotonic()
+        throttle = ReplicationThrottleHelper(self.backend, self.throttle_rate_bytes)
+        if self._pause_sampling and planner.inter_broker:
+            # pause partition sampling while replicas move (:1414)
+            self._pause_sampling("executor: inter-broker replica movement")
+        try:
+            self._inter_broker_phase(planner, throttle)
+            self._intra_broker_phase(planner)
+            self._leadership_phase(planner)
+        finally:
+            throttle.clear_throttles()
+            if self._resume_sampling and planner.inter_broker:
+                self._resume_sampling("executor: execution finished")
+            counts = {s: 0 for s in TaskState}
+            for t in planner.all_tasks:
+                counts[t.state] += 1
+            self._last_summary = ExecutionSummary(
+                execution_id=execution_id,
+                stopped=self._stop_signal.is_set(),
+                completed=counts[TaskState.COMPLETED],
+                dead=counts[TaskState.DEAD],
+                aborted=counts[TaskState.ABORTED] + counts[TaskState.PENDING],
+                duration_s=time.monotonic() - t0,
+            )
+            self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            self.notifier.on_execution_finished(self._last_summary)
+
+    def _now_ms(self) -> int:
+        return int(time.time() * 1000)
+
+    def _inter_broker_phase(
+        self, planner: ExecutionTaskPlanner, throttle: ReplicationThrottleHelper
+    ) -> None:
+        """interBrokerMoveReplicas (Executor.java:1607)."""
+        in_flight: List[ExecutionTask] = []
+        checks = 0
+        while not self._stop_signal.is_set():
+            ready = planner.ready_inter_broker_tasks(self.concurrency, in_flight)
+            if ready:
+                throttle.set_throttles(ready)
+                reassignments = {
+                    t.proposal.tp: t.proposal.new_replicas for t in ready
+                }
+                self.backend.alter_partition_reassignments(reassignments)
+                now = self._now_ms()
+                for t in ready:
+                    t.transition(TaskState.IN_PROGRESS, now)
+                in_flight.extend(ready)
+                self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT
+            if not in_flight and not ready:
+                if planner.remaining(planner.inter_broker) == 0:
+                    break
+                # remaining tasks exist but none ready (caps); loop continues
+            in_flight = self._progress_check(planner, in_flight)
+            checks += 1
+            if checks >= self.max_progress_checks:
+                self._mark_dead(in_flight)
+                break
+            if in_flight or planner.remaining(planner.inter_broker):
+                time.sleep(self.progress_check_interval_s)
+            else:
+                break
+        if self._stop_signal.is_set():
+            self._abort_pending(planner.inter_broker)
+            # in-flight reassignments finish server-side; wait them out (bounded)
+            drain_checks = 0
+            while in_flight and drain_checks < self.max_progress_checks:
+                in_flight = self._progress_check(planner, in_flight)
+                drain_checks += 1
+                if in_flight:
+                    time.sleep(self.progress_check_interval_s)
+            self._mark_dead(in_flight)
+
+    def _progress_check(
+        self, planner: ExecutionTaskPlanner, in_flight: List[ExecutionTask]
+    ) -> List[ExecutionTask]:
+        """One progress-check interval: completed = no longer listed as reassigning;
+        dead = a destination broker died (ExecutionUtils progress semantics)."""
+        ongoing = set(self.backend.list_partition_reassignments().keys())
+        alive = {
+            b for b, i in self.backend.describe_cluster().brokers.items() if i.alive
+        }
+        still: List[ExecutionTask] = []
+        now = self._now_ms()
+        for t in in_flight:
+            if t.proposal.tp not in ongoing:
+                t.transition(TaskState.COMPLETED, now)
+            elif not set(t.proposal.replicas_to_add) <= alive:
+                t.transition(TaskState.DEAD, now)
+            else:
+                still.append(t)
+        # concurrency auto-adjustment tick from cluster health (AIMD)
+        under_min = at_min = 0
+        for infos in self.backend.describe_topics().values():
+            for i in infos:
+                if len(i.isr) < self.min_insync_replicas:
+                    under_min += 1
+                elif len(i.isr) == self.min_insync_replicas and len(i.isr) < len(i.replicas):
+                    at_min += 1
+        self.adjuster.tick(num_under_min_isr=under_min, num_at_min_isr=at_min)
+        return still
+
+    def _intra_broker_phase(self, planner: ExecutionTaskPlanner) -> None:
+        """intraBrokerMoveReplicas (:1679) — logdir moves via the backend."""
+        if self._stop_signal.is_set() or not planner.intra_broker:
+            return
+        self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT
+        while not self._stop_signal.is_set():
+            batch = planner.ready_intra_broker_tasks(self.concurrency.config.intra_broker_moves)
+            if not batch:
+                break
+            moves = {}
+            now = self._now_ms()
+            for t in batch:
+                broker, path = t.logdir_move
+                moves[(t.proposal.tp, broker)] = path
+                t.transition(TaskState.IN_PROGRESS, now)
+            self.backend.alter_replica_logdirs(moves)
+            now = self._now_ms()
+            for t in batch:
+                t.transition(TaskState.COMPLETED, now)
+
+    def _leadership_phase(self, planner: ExecutionTaskPlanner) -> None:
+        """moveLeaderships in batches (:1742,1769) → backend.elect_leaders."""
+        if self._stop_signal.is_set():
+            self._abort_pending(planner.leadership)
+            return
+        if planner.leadership:
+            self._state = ExecutorState.LEADER_MOVEMENT
+        while not self._stop_signal.is_set():
+            batch = planner.ready_leadership_batch(self.concurrency.config.leadership_batch)
+            if not batch:
+                break
+            now = self._now_ms()
+            for t in batch:
+                t.transition(TaskState.IN_PROGRESS, now)
+            # a leadership change = replica-list reorder (preferred leader first)
+            # then preferred-leader election — the reassignment carries no data
+            # (same broker set), matching how PLE picks replicas[0]
+            reorder = {
+                t.proposal.tp: t.proposal.new_replicas
+                for t in batch
+                if t.proposal.new_replicas != t.proposal.old_replicas
+            }
+            if reorder:
+                self.backend.alter_partition_reassignments(reorder)
+                checks = 0
+                while checks < self.max_progress_checks:
+                    ongoing = set(self.backend.list_partition_reassignments())
+                    if not (ongoing & set(reorder)):
+                        break
+                    checks += 1
+                    time.sleep(self.progress_check_interval_s)
+            self.backend.elect_leaders([t.proposal.tp for t in batch])
+            now = self._now_ms()
+            for t in batch:
+                t.transition(TaskState.COMPLETED, now)
+        if self._stop_signal.is_set():
+            self._abort_pending(planner.leadership)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _abort_pending(self, pool: List[ExecutionTask]) -> None:
+        now = self._now_ms()
+        for t in pool:
+            if t.state is TaskState.PENDING:
+                t.transition(TaskState.ABORTED, now)
+
+    def _mark_dead(self, in_flight: List[ExecutionTask]) -> None:
+        now = self._now_ms()
+        for t in in_flight:
+            if t.state is TaskState.IN_PROGRESS:
+                t.transition(TaskState.DEAD, now)

@@ -70,7 +70,6 @@ class ClusterArrays:
     broker_alive: jax.Array        # bool[B]
     broker_new: jax.Array          # bool[B]
     broker_demoted: jax.Array      # bool[B]
-    broker_offline_replicas: jax.Array  # bool[R] replica currently offline (dead broker/disk)
 
     # disk axis (JBOD; zero-length arrays when not configured)
     disk_broker: jax.Array         # i32[D]
@@ -254,13 +253,15 @@ def relocate_replicas(
     replica_idx = jnp.asarray(replica_idx)
     dst_broker = jnp.asarray(dst_broker)
     ok = replica_idx >= 0
-    safe_idx = jnp.where(ok, replica_idx, 0)
-    new_broker = jnp.where(ok, dst_broker, state.replica_broker[safe_idx])
-    target_disk = jnp.asarray(dst_disk) if dst_disk is not None else jnp.full_like(safe_idx, -1)
-    new_disk = jnp.where(ok, target_disk, state.replica_disk[safe_idx])
+    # no-op entries scatter to an out-of-range index, which jax drops — crucial,
+    # because routing them to a real index would add duplicate writes that can
+    # stomp a genuine update in the same batch.
+    oob = jnp.int32(state.num_replicas)
+    idx = jnp.where(ok, replica_idx, oob)
+    target_disk = jnp.asarray(dst_disk) if dst_disk is not None else jnp.full_like(replica_idx, -1)
     return state.replace(
-        replica_broker=state.replica_broker.at[safe_idx].set(new_broker),
-        replica_disk=state.replica_disk.at[safe_idx].set(new_disk),
+        replica_broker=state.replica_broker.at[idx].set(dst_broker, mode="drop"),
+        replica_disk=state.replica_disk.at[idx].set(target_disk, mode="drop"),
     )
 
 
@@ -275,9 +276,11 @@ def relocate_leadership(
     partition_idx = jnp.asarray(partition_idx)
     dst_replica = jnp.asarray(dst_replica)
     ok = partition_idx >= 0
-    safe_p = jnp.where(ok, partition_idx, 0)
-    new_leader = jnp.where(ok, dst_replica, state.partition_leader[safe_p])
-    return state.replace(partition_leader=state.partition_leader.at[safe_p].set(new_leader))
+    oob = jnp.int32(state.num_partitions)
+    idx = jnp.where(ok, partition_idx, oob)  # no-ops dropped (see relocate_replicas)
+    return state.replace(
+        partition_leader=state.partition_leader.at[idx].set(dst_replica, mode="drop")
+    )
 
 
 def swap_replicas(
@@ -287,15 +290,16 @@ def swap_replicas(
     replica_a = jnp.asarray(replica_a)
     replica_b = jnp.asarray(replica_b)
     ok = (replica_a >= 0) & (replica_b >= 0)
-    sa = jnp.where(ok, replica_a, 0)
-    sb = jnp.where(ok, replica_b, 0)
-    ba = state.replica_broker[sa]
-    bb = state.replica_broker[sb]
-    brokers = state.replica_broker.at[sa].set(jnp.where(ok, bb, ba))
-    brokers = brokers.at[sb].set(jnp.where(ok, ba, bb))
+    oob = jnp.int32(state.num_replicas)
+    sa = jnp.where(ok, replica_a, oob)  # no-ops dropped (see relocate_replicas)
+    sb = jnp.where(ok, replica_b, oob)
+    ba = state.replica_broker[jnp.where(ok, replica_a, 0)]
+    bb = state.replica_broker[jnp.where(ok, replica_b, 0)]
+    brokers = state.replica_broker.at[sa].set(bb, mode="drop")
+    brokers = brokers.at[sb].set(ba, mode="drop")
     # logdir placement does not survive a cross-broker move (see relocate_replicas)
-    disks = state.replica_disk.at[sa].set(jnp.where(ok, -1, state.replica_disk[sa]))
-    disks = disks.at[sb].set(jnp.where(ok, -1, disks[sb]))
+    disks = state.replica_disk.at[sa].set(-1, mode="drop")
+    disks = disks.at[sb].set(-1, mode="drop")
     return state.replace(replica_broker=brokers, replica_disk=disks)
 
 
@@ -310,8 +314,6 @@ def set_broker_state(
     out = state
     if alive is not None:
         out = out.replace(broker_alive=out.broker_alive.at[broker_id].set(alive))
-        offline = out.replica_offline_mask()
-        out = out.replace(broker_offline_replicas=offline)
     if new is not None:
         out = out.replace(broker_new=out.broker_new.at[broker_id].set(new))
     if demoted is not None:

@@ -217,12 +217,21 @@ class ClusterModel:
 
     # -- snapshot ------------------------------------------------------------
 
-    def to_arrays(self, pad_replicas_to: Optional[int] = None):
+    def to_arrays(
+        self,
+        pad_replicas_to: Optional[int] = None,
+        pad_partitions_to: Optional[int] = None,
+        pad_topics_to: Optional[int] = None,
+    ):
         """Flatten into an immutable :class:`ClusterArrays` + :class:`IndexMaps`.
 
         Replicas missing a measured load get zeros (the reference raises on
         incomplete load during model build; the monitor layer enforces completeness
         before snapshotting, so zeros here only occur in hand-built test models).
+
+        The ``pad_*`` arguments round axis sizes up (padded replicas are masked by
+        ``replica_valid``; padded partitions carry no replicas and leader −1) so
+        differently-sized models can share one compiled solver shape.
         """
         import jax.numpy as jnp
 
@@ -254,7 +263,9 @@ class ClusterModel:
         if R < n_live:
             raise ValueError(f"pad_replicas_to={R} < live replicas {n_live}")
 
-        P, B, D = len(partitions), len(broker_ids), len(disks)
+        B, D = len(broker_ids), len(disks)
+        P = max(pad_partitions_to or 0, len(partitions))
+        num_topics = max(pad_topics_to or 0, len(topic_names))
         replica_partition = np.zeros(R, np.int32)
         replica_broker = np.zeros(R, np.int32)
         replica_disk = np.full(R, -1, np.int32)
@@ -336,15 +347,13 @@ class ClusterModel:
             broker_alive=jnp.asarray(broker_alive),
             broker_new=jnp.asarray(broker_new),
             broker_demoted=jnp.asarray(broker_demoted),
-            broker_offline_replicas=jnp.zeros(R, bool),
             disk_broker=jnp.asarray(disk_broker),
             disk_capacity=jnp.asarray(disk_capacity),
             disk_alive=jnp.asarray(disk_alive),
             num_racks=len(rack_names),
-            num_topics=len(topic_names),
+            num_topics=num_topics,
             num_hosts=len(host_names),
         )
-        state = state.replace(broker_offline_replicas=state.replica_offline_mask())
         maps = IndexMaps(
             broker_ids=broker_ids,
             broker_index=broker_index,

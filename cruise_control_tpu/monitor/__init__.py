@@ -1,0 +1,52 @@
+"""Monitor layer: sampling, aggregation, and cluster-model construction.
+
+Counterpart of ``cruise-control/src/main/java/.../monitor/`` (SURVEY §2.3).
+"""
+
+from cruise_control_tpu.monitor.capacity import (
+    BrokerCapacityInfo,
+    BrokerCapacityResolver,
+    FileCapacityResolver,
+    StaticCapacityResolver,
+)
+from cruise_control_tpu.monitor.completeness import (
+    ModelCompletenessRequirements,
+    NotEnoughValidSnapshotsError,
+)
+from cruise_control_tpu.monitor.loadmonitor import LoadMonitor, LoadMonitorState, MonitorState
+from cruise_control_tpu.monitor.processor import MetricsProcessor
+from cruise_control_tpu.monitor.samples import (
+    BackendMetricSampler,
+    BrokerMetricSample,
+    MetricSampler,
+    NoopSampler,
+    PartitionMetricSample,
+    SampleBatch,
+)
+from cruise_control_tpu.monitor.samplestore import (
+    FileSampleStore,
+    NoopSampleStore,
+    SampleStore,
+)
+
+__all__ = [
+    "BackendMetricSampler",
+    "BrokerCapacityInfo",
+    "BrokerCapacityResolver",
+    "BrokerMetricSample",
+    "FileCapacityResolver",
+    "FileSampleStore",
+    "LoadMonitor",
+    "LoadMonitorState",
+    "MetricSampler",
+    "MetricsProcessor",
+    "ModelCompletenessRequirements",
+    "MonitorState",
+    "NoopSampleStore",
+    "NoopSampler",
+    "NotEnoughValidSnapshotsError",
+    "PartitionMetricSample",
+    "SampleBatch",
+    "SampleStore",
+    "StaticCapacityResolver",
+]

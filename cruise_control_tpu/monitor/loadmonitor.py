@@ -1,0 +1,349 @@
+"""LoadMonitor: sampling orchestration and cluster-model construction.
+
+Counterpart of ``monitor/LoadMonitor.java:78`` and its task runner
+(``monitor/task/LoadMonitorTaskRunner.java:33``):
+
+* owns the partition- and broker-entity sliding-window aggregators
+  (LoadMonitor.java:164-165 → :mod:`cruise_control_tpu.core.aggregator`);
+* drives the sampling state machine NOT_STARTED → RUNNING(SAMPLING) with
+  PAUSED / BOOTSTRAPPING / LOADING excursions, pause/resume with a reason
+  (LoadMonitorTaskRunner states);
+* ``cluster_model()`` (LoadMonitor.java:491-543) aggregates the windows, checks
+  completeness, joins live topology metadata + broker capacities, and emits the
+  host-side :class:`ClusterModel` whose ``to_arrays()`` feeds the TPU solver;
+* a semaphore bounds concurrent model generations
+  (``_clusterModelSemaphore``, LoadMonitor.java:94).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.backend.base import ClusterBackend, TopicPartition
+from cruise_control_tpu.core.aggregator import (
+    AggregationOptions,
+    MetricSampleAggregator,
+    NotEnoughValidWindowsError,
+)
+from cruise_control_tpu.core.metricdef import (
+    BROKER_METRIC_DEF,
+    COMMON_METRIC_DEF,
+)
+from cruise_control_tpu.core.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model.cluster import BrokerState, ClusterModel
+from cruise_control_tpu.model.model_utils import follower_cpu_from_leader_load
+from cruise_control_tpu.monitor.capacity import BrokerCapacityResolver
+from cruise_control_tpu.monitor.completeness import (
+    ModelCompletenessRequirements,
+    NotEnoughValidSnapshotsError,
+)
+from cruise_control_tpu.monitor.samples import MetricSampler, SampleBatch
+from cruise_control_tpu.monitor.samplestore import NoopSampleStore, SampleStore
+
+_P_IDX = {info.name: info.id for info in COMMON_METRIC_DEF.all()}
+
+
+class MonitorState:
+    NOT_STARTED = "NOT_STARTED"
+    RUNNING = "RUNNING"
+    SAMPLING = "SAMPLING"
+    PAUSED = "PAUSED"
+    BOOTSTRAPPING = "BOOTSTRAPPING"
+    LOADING = "LOADING"
+
+
+@dataclasses.dataclass
+class LoadMonitorState:
+    """STATE-endpoint payload (LoadMonitorState.java)."""
+
+    state: str
+    reason_of_latest_pause_or_resume: Optional[str]
+    num_valid_windows: int
+    monitored_windows: List[int]
+    num_monitored_partitions: int
+    total_num_partitions: int
+    monitoring_coverage_pct: float
+    last_sample_ts_ms: int
+
+
+class LoadMonitor:
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        sampler: MetricSampler,
+        capacity_resolver: BrokerCapacityResolver,
+        num_windows: int = 5,
+        window_ms: int = 60_000,
+        min_samples_per_window: int = 1,
+        sample_store: Optional[SampleStore] = None,
+        max_concurrent_model_generations: int = 1,
+    ) -> None:
+        self.backend = backend
+        self.sampler = sampler
+        self.capacity_resolver = capacity_resolver
+        self.window_ms = window_ms
+        self.sample_store = sample_store or NoopSampleStore()
+        self._partition_agg: MetricSampleAggregator[TopicPartition] = MetricSampleAggregator(
+            num_windows, window_ms, min_samples_per_window, COMMON_METRIC_DEF
+        )
+        self._broker_agg: MetricSampleAggregator[int] = MetricSampleAggregator(
+            num_windows, window_ms, min_samples_per_window, BROKER_METRIC_DEF
+        )
+        self._state = MonitorState.NOT_STARTED
+        self._pause_reason: Optional[str] = None
+        self._last_sample_ts = 0
+        self._lock = threading.RLock()
+        self._model_semaphore = threading.Semaphore(max_concurrent_model_generations)
+        self._sampling_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, sampling_interval_ms: int = 0) -> None:
+        """Replay persisted samples (LOADING), then mark RUNNING.  When
+        ``sampling_interval_ms`` > 0, spawn the periodic sampling thread
+        (LoadMonitorTaskRunner scheduled sampling)."""
+        with self._lock:
+            self._state = MonitorState.LOADING
+        replayed = self.sample_store.replay(self._ingest_batch)
+        with self._lock:
+            self._state = MonitorState.RUNNING
+        if sampling_interval_ms > 0:
+            self._stop.clear()
+            self._sampling_thread = threading.Thread(
+                target=self._sampling_loop, args=(sampling_interval_ms,), daemon=True
+            )
+            self._sampling_thread.start()
+        return None
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._sampling_thread:
+            self._sampling_thread.join(timeout=5)
+        self.sampler.close()
+        self.sample_store.close()
+
+    def _sampling_loop(self, interval_ms: int) -> None:
+        while not self._stop.wait(interval_ms / 1000.0):
+            if self._state == MonitorState.PAUSED:
+                continue
+            self.sample_once()
+
+    # -- sampling -----------------------------------------------------------
+
+    def pause_sampling(self, reason: str) -> None:
+        """PAUSE_SAMPLING endpoint / executor pause (LoadMonitor pause)."""
+        with self._lock:
+            self._state = MonitorState.PAUSED
+            self._pause_reason = reason
+
+    def resume_sampling(self, reason: str) -> None:
+        with self._lock:
+            self._state = MonitorState.RUNNING
+            self._pause_reason = reason
+
+    def sample_once(self, now_ms: Optional[int] = None) -> int:
+        """One sampling task execution: fetch → store → aggregate."""
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        with self._lock:
+            if self._state == MonitorState.PAUSED:
+                return 0
+            prev = self._state
+            self._state = MonitorState.SAMPLING
+        try:
+            # never ask the sampler for more history than the window ring holds
+            # (first tick starts from wall-clock time, not from epoch 0)
+            horizon = now_ms - (self._partition_agg.num_windows + 1) * self.window_ms
+            from_ms = max(self._last_sample_ts, horizon, 0)
+            batch = self.sampler.get_samples(from_ms, now_ms)
+            self.sample_store.store(batch)
+            self._ingest_batch(batch)
+            self._last_sample_ts = now_ms
+            return len(batch)
+        finally:
+            with self._lock:
+                # only restore if nobody (e.g. pause_sampling) changed state meanwhile
+                if self._state == MonitorState.SAMPLING:
+                    self._state = prev
+
+    def bootstrap(self, from_ms: int, to_ms: int) -> int:
+        """BOOTSTRAP endpoint: rebuild windows from a historical range
+        (LoadMonitorTaskRunner.bootstrap:137-174)."""
+        with self._lock:
+            prev = self._state
+            self._state = MonitorState.BOOTSTRAPPING
+        try:
+            batch = self.sampler.get_samples(from_ms, to_ms)
+            self._ingest_batch(batch)
+            self._last_sample_ts = max(self._last_sample_ts, to_ms)
+            return len(batch)
+        finally:
+            with self._lock:
+                if self._state == MonitorState.BOOTSTRAPPING:
+                    self._state = prev
+
+    def _ingest_batch(self, batch: SampleBatch) -> None:
+        for s in batch.partition_samples:
+            self._partition_agg.add_sample(s.tp, s.ts_ms, s.values)
+        for s in batch.broker_samples:
+            self._broker_agg.add_sample(s.broker_id, s.ts_ms, s.values)
+
+    # -- model generation ---------------------------------------------------
+
+    def acquire_for_model_generation(self, timeout_s: float = 60.0):
+        """Context manager bounding concurrent model builds (semaphore :94)."""
+        monitor = self
+
+        class _Guard:
+            def __enter__(self):
+                if not monitor._model_semaphore.acquire(timeout=timeout_s):
+                    raise TimeoutError("cluster model semaphore")
+                return monitor
+
+            def __exit__(self, *exc):
+                monitor._model_semaphore.release()
+
+        return _Guard()
+
+    def cluster_model(
+        self,
+        from_ms: int = 0,
+        to_ms: Optional[int] = None,
+        requirements: ModelCompletenessRequirements = ModelCompletenessRequirements(),
+    ) -> ClusterModel:
+        """Build the host-side ClusterModel (LoadMonitor.clusterModel:491-543).
+
+        Joins: aggregated partition windows (load), live metadata (placement,
+        leadership, broker aliveness), capacity resolver (per-broker capacities,
+        JBOD logdirs).  Raises :class:`NotEnoughValidSnapshotsError` when the
+        completeness requirements cannot be met.
+        """
+        with self.acquire_for_model_generation():
+            return self._cluster_model_locked(from_ms, to_ms, requirements)
+
+    def _cluster_model_locked(
+        self,
+        from_ms: int,
+        to_ms: Optional[int],
+        requirements: ModelCompletenessRequirements,
+    ) -> ClusterModel:
+        description = self.backend.describe_cluster()
+        topics = self.backend.describe_topics()
+        all_partitions = [i.tp for infos in topics.values() for i in infos]
+
+        try:
+            vae, completeness = self._partition_agg.aggregate(
+                from_ms=from_ms,
+                to_ms=to_ms,
+                options=AggregationOptions(include_invalid_entities=False),
+            )
+        except NotEnoughValidWindowsError as e:
+            raise NotEnoughValidSnapshotsError(str(e)) from e
+
+        # enforce against the completeness report (windows that actually meet
+        # coverage), not just the retention ring's window ids
+        if completeness.num_valid_windows < requirements.min_required_num_windows:
+            raise NotEnoughValidSnapshotsError(
+                f"{completeness.num_valid_windows} valid windows < required "
+                f"{requirements.min_required_num_windows}"
+            )
+        coverage = len(vae.entities) / max(len(all_partitions), 1)
+        if coverage < requirements.min_monitored_partitions_percentage or not vae.entities:
+            raise NotEnoughValidSnapshotsError(
+                f"monitored partition coverage {coverage:.2%} below required "
+                f"{requirements.min_monitored_partitions_percentage:.2%}"
+            )
+
+        loads = self._reduce_windows(vae)
+
+        model = ClusterModel()
+        logdirs_by_broker = self.backend.describe_logdirs()
+        for broker_id, info in sorted(description.brokers.items()):
+            cap = self.capacity_resolver.capacity_for(broker_id)
+            model.create_broker(
+                info.rack,
+                broker_id,
+                cap.capacity,
+                host=info.host,
+                logdirs=cap.disk_capacity_by_logdir,
+            )
+            if not info.alive:
+                model.set_broker_state(broker_id, BrokerState.DEAD)
+            else:
+                for path, d in logdirs_by_broker.get(broker_id, {}).items():
+                    if d.offline and cap.disk_capacity_by_logdir and path in cap.disk_capacity_by_logdir:
+                        model.mark_disk_dead(broker_id, path)
+
+        monitored = set(vae.entities)
+        for topic, infos in sorted(topics.items()):
+            for pinfo in infos:
+                if requirements.include_all_topics is False and pinfo.tp not in monitored:
+                    continue
+                leader = pinfo.leader
+                load = loads.get(pinfo.tp)
+                for pos, broker_id in enumerate(pinfo.replicas):
+                    if broker_id not in description.brokers:
+                        continue
+                    is_leader = broker_id == leader
+                    model.create_replica(broker_id, pinfo.tp, pos, is_leader)
+                    if load is None:
+                        continue
+                    cpu, nw_in, nw_out, disk = load
+                    if is_leader:
+                        model.set_replica_load(
+                            broker_id, pinfo.tp, [cpu, nw_in, nw_out, disk]
+                        )
+                    else:
+                        fcpu = float(
+                            follower_cpu_from_leader_load(nw_in, nw_out, cpu)
+                        )
+                        model.set_replica_load(
+                            broker_id, pinfo.tp, [fcpu, nw_in, 0.0, disk]
+                        )
+        return model
+
+    def _reduce_windows(self, vae) -> Dict[TopicPartition, Tuple[float, float, float, float]]:
+        """Windows → expected utilization (Load.expectedUtilizationFor, Load.java:81-98):
+        AVG metrics average over valid windows, LATEST (disk) takes the newest."""
+        values = vae.values  # [E, W, M]
+        out: Dict[TopicPartition, Tuple[float, float, float, float]] = {}
+        cpu_i, disk_i = _P_IDX["CPU_USAGE"], _P_IDX["DISK_USAGE"]
+        in_i, out_i = _P_IDX["LEADER_BYTES_IN"], _P_IDX["LEADER_BYTES_OUT"]
+        for e, tp in enumerate(vae.entities):
+            v = values[e]
+            out[tp] = (
+                float(v[:, cpu_i].mean()),
+                float(v[:, in_i].mean()),
+                float(v[:, out_i].mean()),
+                float(v[-1, disk_i]),   # LATEST: newest window
+            )
+        return out
+
+    # -- state --------------------------------------------------------------
+
+    def state(self) -> LoadMonitorState:
+        description = self.backend.describe_topics()
+        total = sum(len(v) for v in description.values())
+        try:
+            vae, completeness = self._partition_agg.aggregate(
+                options=AggregationOptions(include_invalid_entities=False)
+            )
+            valid_windows = vae.window_ids
+            monitored = len(vae.entities)
+        except NotEnoughValidWindowsError:
+            valid_windows, monitored = [], 0
+        return LoadMonitorState(
+            state=self._state,
+            reason_of_latest_pause_or_resume=self._pause_reason,
+            num_valid_windows=len(valid_windows),
+            monitored_windows=list(valid_windows),
+            num_monitored_partitions=monitored,
+            total_num_partitions=total,
+            monitoring_coverage_pct=monitored / max(total, 1),
+            last_sample_ts_ms=self._last_sample_ts,
+        )

@@ -1,0 +1,119 @@
+"""Sample persistence: the checkpoint/replay path of the monitor.
+
+Counterpart of the ``SampleStore`` SPI and ``KafkaSampleStore``
+(``monitor/sampling/KafkaSampleStore.java:68``, ``storeSamples``:178,
+``loadSamples``:203): every processed sample batch is persisted so monitor state
+(the sliding windows) survives restarts, replayed through the same ``add_sample``
+path on startup.  The TPU framework checkpoints to local newline-JSON segment files
+(one per flush) instead of compacted Kafka topics; the SPI keeps that pluggable.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import os
+import threading
+from typing import Callable, List, Optional
+
+from cruise_control_tpu.monitor.samples import (
+    BrokerMetricSample,
+    PartitionMetricSample,
+    SampleBatch,
+)
+
+
+class SampleStore(abc.ABC):
+    @abc.abstractmethod
+    def store(self, batch: SampleBatch) -> None: ...
+
+    @abc.abstractmethod
+    def replay(self, consumer: Callable[[SampleBatch], None]) -> int:
+        """Feed all persisted samples to ``consumer``; returns samples replayed."""
+
+    def close(self) -> None:  # pragma: no cover
+        pass
+
+
+class NoopSampleStore(SampleStore):
+    def store(self, batch: SampleBatch) -> None:
+        pass
+
+    def replay(self, consumer) -> int:
+        return 0
+
+
+class FileSampleStore(SampleStore):
+    """Append-only JSONL segments under a directory, replayed in order."""
+
+    def __init__(self, directory: str, max_segment_records: int = 100_000) -> None:
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.max_segment_records = max_segment_records
+        self._lock = threading.Lock()
+        self._segment_idx = self._next_segment_index()
+        self._records_in_segment = 0
+        self._fh = None
+
+    def _next_segment_index(self) -> int:
+        existing = [
+            int(f.split(".")[0].split("-")[1])
+            for f in os.listdir(self.directory)
+            if f.startswith("segment-") and f.endswith(".jsonl")
+        ]
+        return max(existing, default=-1) + 1
+
+    def _segment_path(self, idx: int) -> str:
+        return os.path.join(self.directory, f"segment-{idx:06d}.jsonl")
+
+    def store(self, batch: SampleBatch) -> None:
+        with self._lock:
+            if self._fh is None or self._records_in_segment >= self.max_segment_records:
+                if self._fh:
+                    self._fh.close()
+                    self._segment_idx += 1
+                self._fh = open(self._segment_path(self._segment_idx), "a")
+                self._records_in_segment = 0
+            for s in batch.partition_samples:
+                self._fh.write(json.dumps(s.to_record()) + "\n")
+            for s in batch.broker_samples:
+                self._fh.write(json.dumps(s.to_record()) + "\n")
+            self._records_in_segment += len(batch)
+            self._fh.flush()
+
+    def replay(self, consumer: Callable[[SampleBatch], None]) -> int:
+        total = 0
+        with self._lock:
+            names = sorted(
+                f for f in os.listdir(self.directory)
+                if f.startswith("segment-") and f.endswith(".jsonl")
+            )
+        for name in names:
+            psamples: List[PartitionMetricSample] = []
+            bsamples: List[BrokerMetricSample] = []
+            with open(os.path.join(self.directory, name)) as fh:
+                for line in fh:
+                    rec = json.loads(line)
+                    if rec["type"] == "partition":
+                        psamples.append(
+                            PartitionMetricSample(
+                                (rec["topic"], rec["partition"]),
+                                rec["broker"],
+                                rec["ts"],
+                                tuple(rec["values"]),
+                            )
+                        )
+                    else:
+                        bsamples.append(
+                            BrokerMetricSample(rec["broker"], rec["ts"], tuple(rec["values"]))
+                        )
+            batch = SampleBatch(psamples, bsamples)
+            consumer(batch)
+            total += len(batch)
+        return total
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None

@@ -126,7 +126,7 @@ def test_dead_broker_offline_replicas():
     assert not bool(np.asarray(state.broker_alive)[maps.broker_index[1]])
     # no replicas on broker 1 in this fixture; mark broker 0 dead via array op
     state2 = A.set_broker_state(state, maps.broker_index[0], alive=False)
-    offline = np.asarray(state2.broker_offline_replicas)
+    offline = np.asarray(state2.replica_offline_mask())
     assert offline.sum() == 2  # both replicas live on broker 0
 
 
@@ -146,7 +146,7 @@ def test_jbod_disks_and_disk_death():
     cluster.mark_disk_dead(0, "/d0")
     assert cluster.broker_state(0) == BrokerState.BAD_DISKS
     state2, maps2 = cluster.to_arrays()
-    offline = np.asarray(state2.broker_offline_replicas)
+    offline = np.asarray(state2.replica_offline_mask())
     assert offline.sum() == 1
 
     # a cross-broker move resets the logdir assignment: the source disk stops

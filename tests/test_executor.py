@@ -1,0 +1,155 @@
+"""Executor tests against the fake backend.
+
+Mirrors the reference's ``executor/ExecutorTest`` / ``ExecutionTaskPlannerTest`` tier
+(SURVEY §4 tier 3): proposal → task planning, strategy ordering, 3-phase execution,
+concurrency caps, throttling, and stop semantics.
+"""
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.backend import FakeClusterBackend
+from cruise_control_tpu.executor import (
+    ConcurrencyConfig,
+    ExecutionTaskPlanner,
+    Executor,
+    ExecutionConcurrencyManager,
+    OngoingExecutionError,
+    PrioritizeSmallReplicaMovementStrategy,
+    StrategyContext,
+    TaskState,
+)
+
+
+def make_backend(latency=1):
+    backend = FakeClusterBackend(reassignment_latency_polls=latency)
+    for b in range(4):
+        backend.add_broker(b, rack=str(b % 2))
+    for p in range(6):
+        backend.create_partition(("T", p), [p % 4, (p + 1) % 4], load=[1.0, 10.0, 10.0, 100.0])
+    return backend
+
+
+def move_proposal(tp, old, new, size=100.0):
+    return ExecutionProposal(
+        tp=tp, partition_size=size, old_leader=old[0],
+        old_replicas=tuple(old), new_replicas=tuple(new),
+    )
+
+
+class TestPlanner:
+    def test_split_and_order_by_strategy(self):
+        p_small = move_proposal(("T", 0), [0, 1], [2, 1], size=10.0)
+        p_big = move_proposal(("T", 1), [1, 2], [3, 2], size=500.0)
+        p_lead = move_proposal(("T", 2), [2, 3], [3, 2])  # leadership only
+        planner = ExecutionTaskPlanner([PrioritizeSmallReplicaMovementStrategy()])
+        planner.add_proposals([p_big, p_small, p_lead])
+        assert [t.proposal.tp for t in planner.inter_broker] == [("T", 0), ("T", 1)]
+        # p_small/p_big also change the leader, so they plan a leadership task too
+        assert {t.proposal.tp for t in planner.leadership} == {
+            ("T", 0), ("T", 1), ("T", 2)
+        }
+
+    def test_concurrency_caps_respected(self):
+        proposals = [
+            move_proposal(("T", i), [0, 1], [2 + (i % 2), 1]) for i in range(6)
+        ]
+        planner = ExecutionTaskPlanner()
+        planner.add_proposals(proposals)
+        mgr = ExecutionConcurrencyManager(ConcurrencyConfig(per_broker_moves=2, cluster_moves=10))
+        ready = planner.ready_inter_broker_tasks(mgr, in_flight=[])
+        # every task touches broker 0 (remove) — per-broker cap of 2 binds
+        assert len(ready) == 2
+
+
+class TestExecution:
+    def test_three_phase_execution_applies_to_backend(self):
+        backend = make_backend()
+        executor = Executor(backend, throttle_rate_bytes=1e6)
+        proposals = [
+            move_proposal(("T", 0), [0, 1], [2, 1]),
+            move_proposal(("T", 1), [1, 2], [1, 3]),
+            move_proposal(("T", 2), [2, 3], [3, 2]),  # leadership
+        ]
+        summary = executor.execute_proposals(proposals)
+        assert summary.succeeded, vars(summary)
+        topics = backend.describe_topics()
+        by_tp = {i.tp: i for infos in topics.values() for i in infos}
+        assert set(by_tp[("T", 0)].replicas) == {1, 2}
+        assert set(by_tp[("T", 1)].replicas) == {1, 3}
+        assert by_tp[("T", 2)].leader == 3
+        # throttles set then cleared
+        kinds = [k for k, _ in backend.admin_log]
+        assert "throttle" in kinds and kinds[-1] != "throttle"
+        assert backend.current_throttle is None
+
+    def test_execution_pauses_and_resumes_sampling(self):
+        backend = make_backend()
+        events = []
+        executor = Executor(
+            backend,
+            pause_sampling=lambda r: events.append(("pause", r)),
+            resume_sampling=lambda r: events.append(("resume", r)),
+        )
+        executor.execute_proposals([move_proposal(("T", 0), [0, 1], [2, 1])])
+        assert events[0][0] == "pause" and events[-1][0] == "resume"
+
+    def test_reject_concurrent_execution(self):
+        backend = make_backend(latency=50)
+        executor = Executor(backend, progress_check_interval_s=0.01)
+        executor.execute_proposals(
+            [move_proposal(("T", 0), [0, 1], [2, 1])], wait=False
+        )
+        with pytest.raises(OngoingExecutionError):
+            executor.execute_proposals([move_proposal(("T", 1), [1, 2], [1, 3])])
+        executor.stop_execution()
+        executor.await_completion()
+
+    def test_stop_execution_aborts_pending(self):
+        backend = make_backend(latency=100)
+        executor = Executor(
+            backend,
+            concurrency=ConcurrencyConfig(per_broker_moves=1, cluster_moves=1),
+            progress_check_interval_s=0.01,
+        )
+        proposals = [
+            move_proposal(("T", i), [0, 1], [2 + (i % 2), 1]) for i in range(4)
+        ]
+        executor.execute_proposals(proposals, wait=False)
+        import time
+
+        time.sleep(0.05)
+        executor.stop_execution()
+        summary = executor.await_completion(timeout_s=30)
+        assert summary is not None and summary.stopped
+
+    def test_dead_destination_marks_task_dead(self):
+        backend = make_backend(latency=3)
+        executor = Executor(backend, progress_check_interval_s=0.01)
+        import threading, time
+
+        def killer():
+            time.sleep(0.015)
+            backend.kill_broker(2)
+
+        t = threading.Thread(target=killer)
+        t.start()
+        # leader stays 0, so only a single replica-move task is planned
+        summary = executor.execute_proposals([move_proposal(("T", 0), [0, 1], [0, 2])])
+        t.join()
+        # either it completed before the kill or it was marked dead — never hangs
+        assert summary.completed + summary.dead == 1
+
+
+class TestCombinedProposal:
+    def test_replica_move_with_leadership_change(self):
+        """A proposal carrying both a follower move AND a leadership transfer must
+        apply both (planner emits one task per action)."""
+        backend = make_backend()
+        executor = Executor(backend)
+        # (T,0): replicas [0,1] leader 0 -> replicas (2,0): 1 moves to 2, leader 2
+        summary = executor.execute_proposals([move_proposal(("T", 0), [0, 1], [2, 0])])
+        assert summary.succeeded
+        by_tp = {i.tp: i for infos in backend.describe_topics().values() for i in infos}
+        assert set(by_tp[("T", 0)].replicas) == {0, 2}
+        assert by_tp[("T", 0)].leader == 2
