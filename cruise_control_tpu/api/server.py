@@ -1,0 +1,508 @@
+"""REST API: the full endpoint surface on the stdlib HTTP server.
+
+Counterpart of the servlet/vertx front-ends (``servlet/CruiseControlEndPoint.java:16-39``
+lists the 22 endpoints; dispatch mirrors ``KafkaCruiseControlRequestHandler.doGetOrPost``):
+
+GET  STATE LOAD PARTITION_LOAD PROPOSALS KAFKA_CLUSTER_STATE USER_TASKS
+     REVIEW_BOARD PERMISSIONS BOOTSTRAP TRAIN
+POST REBALANCE ADD_BROKER REMOVE_BROKER DEMOTE_BROKER FIX_OFFLINE_REPLICAS
+     STOP_PROPOSAL_EXECUTION PAUSE_SAMPLING RESUME_SAMPLING TOPIC_CONFIGURATION
+     RIGHTSIZE REMOVE_DISKS ADMIN REVIEW
+
+Long-running POSTs flow through the :class:`UserTaskManager` (202 + ``User-Task-ID``
+until done), optionally parked in the :class:`Purgatory` when two-step verification
+is on; authn/z via the pluggable :class:`SecurityProvider`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.api.purgatory import Purgatory
+from cruise_control_tpu.api.security import (
+    AuthenticationError,
+    NoSecurityProvider,
+    SecurityProvider,
+)
+from cruise_control_tpu.api.usertasks import TaskStatus, UserTaskManager
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.detector import AnomalyType
+from cruise_control_tpu.facade import CruiseControl, OperationResult
+from cruise_control_tpu.model import arrays as A
+
+API_PREFIX = "/kafkacruisecontrol/"
+
+GET_ENDPOINTS = {
+    "STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE",
+    "USER_TASKS", "REVIEW_BOARD", "PERMISSIONS", "BOOTSTRAP", "TRAIN",
+}
+POST_ENDPOINTS = {
+    "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
+    "FIX_OFFLINE_REPLICAS", "STOP_PROPOSAL_EXECUTION", "PAUSE_SAMPLING",
+    "RESUME_SAMPLING", "TOPIC_CONFIGURATION", "RIGHTSIZE", "REMOVE_DISKS",
+    "ADMIN", "REVIEW",
+}
+#: POSTs that change cluster state and thus go through two-step verification
+REVIEWABLE = POST_ENDPOINTS - {"REVIEW"}
+
+
+def _qbool(params: Dict[str, List[str]], name: str, default: bool) -> bool:
+    v = params.get(name, [None])[0]
+    if v is None:
+        return default
+    return v.lower() in ("true", "1", "yes")
+
+
+def _qint_list(params: Dict[str, List[str]], name: str) -> List[int]:
+    v = params.get(name, [None])[0]
+    return [int(x) for x in v.split(",")] if v else []
+
+
+def _goal_ids(params: Dict[str, List[str]]) -> Optional[List[int]]:
+    v = params.get("goals", [None])[0]
+    if not v:
+        return None
+    out = []
+    for name in v.split(","):
+        if name not in G.GOAL_ID_BY_NAME:
+            raise ValueError(f"unknown goal {name!r}")
+        out.append(G.GOAL_ID_BY_NAME[name])
+    return out
+
+
+def _op_result_json(op: OperationResult) -> dict:
+    r = op.optimizer_result
+    return {
+        "dryrun": op.dryrun,
+        "proposals": [
+            {
+                "topic": p.tp[0],
+                "partition": p.tp[1],
+                "oldLeader": p.old_leader,
+                "oldReplicas": list(p.old_replicas),
+                "newReplicas": list(p.new_replicas),
+            }
+            for p in r.proposals[:1000]
+        ],
+        "numProposals": len(r.proposals),
+        "violationsBefore": r.violations_before,
+        "violationsAfter": r.violations_after,
+        "violatedHardGoals": r.violated_hard_goals,
+        "provisionStatus": r.provision.status if r.provision else None,
+        "balancedness": r.balancedness_score if r.goal_reports else None,
+        "goalSummary": [
+            {
+                "goal": g.name,
+                "hard": g.is_hard,
+                "violationsBefore": g.violations_before,
+                "violationsAfter": g.violations_after,
+                "moves": g.moves_applied,
+                "durationS": round(g.duration_s, 3),
+            }
+            for g in r.goal_reports
+        ],
+        "execution": (
+            None
+            if op.execution is None
+            else {
+                "completed": op.execution.completed,
+                "dead": op.execution.dead,
+                "aborted": op.execution.aborted,
+                "stopped": op.execution.stopped,
+                "durationS": round(op.execution.duration_s, 3),
+            }
+        ),
+    }
+
+
+class CruiseControlApp:
+    """Wires facade + detector manager + provisioner + API state (the
+    ``KafkaCruiseControlApp``/``AsyncKafkaCruiseControl`` role)."""
+
+    def __init__(
+        self,
+        cruise_control: CruiseControl,
+        anomaly_manager=None,
+        provisioner=None,
+        security: Optional[SecurityProvider] = None,
+        two_step_verification: bool = False,
+        proposal_cache_ttl_s: float = 900.0,   # proposal.expiration.ms default
+    ) -> None:
+        self.cc = cruise_control
+        self.anomaly_manager = anomaly_manager
+        self.provisioner = provisioner
+        self.security = security or NoSecurityProvider()
+        self.two_step = two_step_verification
+        self.user_tasks = UserTaskManager()
+        self.purgatory = Purgatory()
+        self.proposal_cache_ttl_s = proposal_cache_ttl_s
+        self._proposal_cache: Optional[Tuple[float, dict]] = None
+        self._lock = threading.Lock()
+
+    # -- GET handlers --------------------------------------------------------
+
+    def get_state(self, params) -> Tuple[int, dict]:
+        body = self.cc.state()
+        if self.anomaly_manager is not None:
+            body["AnomalyDetectorState"] = dataclasses.asdict(self.anomaly_manager.state())
+        return 200, body
+
+    def get_load(self, params) -> Tuple[int, dict]:
+        model = self.cc.cluster_model()
+        state, maps = model.to_arrays()
+        um = np.asarray(A.utilization_matrix(state))     # [8, B]
+        alive = np.asarray(state.broker_alive)
+        rows = []
+        for i, broker_id in enumerate(maps.broker_ids):
+            rows.append(
+                {
+                    "Broker": broker_id,
+                    "Host": maps.host_names[int(np.asarray(state.broker_host)[i])],
+                    "DiskMB": float(um[0, i]),
+                    "CpuPct": float(um[1, i]),
+                    "LeaderNwInRate": float(um[2, i]),
+                    "FollowerNwInRate": float(um[3, i]),
+                    "NwOutRate": float(um[4, i]),
+                    "PnwOutRate": float(um[5, i]),
+                    "Leaders": int(um[6, i]),
+                    "Replicas": int(um[7, i]),
+                    "Alive": bool(alive[i]),
+                }
+            )
+        return 200, {"brokers": rows}
+
+    def get_partition_load(self, params) -> Tuple[int, dict]:
+        res_name = params.get("resource", ["DISK"])[0].upper()
+        res = Resource[res_name] if res_name in Resource.__members__ else Resource.DISK
+        limit = int(params.get("entries", ["100"])[0])
+        model = self.cc.cluster_model()
+        state, maps = model.to_arrays()
+        eff = np.asarray(A.effective_load(state))
+        lead = np.asarray(A.is_leader(state))
+        rp = np.asarray(state.replica_partition)
+        rows = []
+        for p_idx, tp in enumerate(maps.partitions):
+            mask = rp == p_idx
+            rows.append(
+                {
+                    "topic": tp[0],
+                    "partition": tp[1],
+                    "leader": model.leader_of(tp),
+                    "followers": [b for b, is_l in model.replicas_of(tp) if not is_l],
+                    "cpu": float(eff[mask & lead, Resource.CPU].sum()),
+                    "networkInbound": float(eff[mask & lead, Resource.NW_IN].sum()),
+                    "networkOutbound": float(eff[mask & lead, Resource.NW_OUT].sum()),
+                    "disk": float(eff[mask & lead, Resource.DISK].sum()),
+                    "_sort": float(eff[mask & lead, res].sum()),
+                }
+            )
+        rows.sort(key=lambda r: -r["_sort"])
+        for r in rows:
+            del r["_sort"]
+        return 200, {"records": rows[:limit]}
+
+    def get_proposals(self, params) -> Tuple[int, dict]:
+        ignore_cache = _qbool(params, "ignore_proposal_cache", False)
+        with self._lock:
+            cached = self._proposal_cache
+            if (
+                not ignore_cache
+                and cached is not None
+                and time.monotonic() - cached[0] < self.proposal_cache_ttl_s
+            ):
+                return 200, {**cached[1], "cached": True}
+        op = self.cc.rebalance(dryrun=True, goal_ids=_goal_ids(params))
+        body = _op_result_json(op)
+        with self._lock:
+            self._proposal_cache = (time.monotonic(), body)
+        return 200, {**body, "cached": False}
+
+    def get_kafka_cluster_state(self, params) -> Tuple[int, dict]:
+        desc = self.cc.backend.describe_cluster()
+        topics = self.cc.backend.describe_topics()
+        return 200, {
+            "brokers": [
+                {"id": b, "rack": i.rack, "host": i.host, "alive": i.alive}
+                for b, i in sorted(desc.brokers.items())
+            ],
+            "topics": {
+                t: [
+                    {
+                        "partition": i.tp[1],
+                        "leader": i.leader,
+                        "replicas": list(i.replicas),
+                        "isr": list(i.isr),
+                    }
+                    for i in infos
+                ]
+                for t, infos in sorted(topics.items())
+            },
+        }
+
+    def get_user_tasks(self, params) -> Tuple[int, dict]:
+        return 200, {"userTasks": [t.to_dict() for t in self.user_tasks.all_tasks()]}
+
+    def get_review_board(self, params) -> Tuple[int, dict]:
+        return 200, {"requestInfo": [r.to_dict() for r in self.purgatory.board()]}
+
+    def get_permissions(self, params, role=None) -> Tuple[int, dict]:
+        return 200, {"role": role.name if role is not None else "ADMIN"}
+
+    def get_bootstrap(self, params) -> Tuple[int, dict]:
+        start = int(params.get("start", ["0"])[0])
+        end = int(params.get("end", [str(int(time.time() * 1000))])[0])
+        n = self.cc.monitor.bootstrap(start, end)
+        return 200, {"samplesLoaded": n, "from": start, "to": end}
+
+    def get_train(self, params) -> Tuple[int, dict]:
+        start = int(params.get("start", ["0"])[0])
+        end = int(params.get("end", [str(int(time.time() * 1000))])[0])
+        ok = self.cc.train_cpu_model(start, end)
+        return 200, {"trained": ok}
+
+    # -- POST handlers -------------------------------------------------------
+
+    def _async_op(self, endpoint: str, params, work) -> Tuple[int, dict, Dict[str, str]]:
+        key = (endpoint, tuple(sorted((k, tuple(v)) for k, v in params.items())))
+        task = self.user_tasks.get_or_create(endpoint, key, work)
+        headers = {"User-Task-ID": task.task_id}
+        if task.status in (TaskStatus.COMPLETED, TaskStatus.COMPLETED_WITH_ERROR):
+            try:
+                result = task.future.result(timeout=0)
+                return 200, _op_result_json(result), headers
+            except Exception as e:
+                return 500, {"error": str(e), "progress": task.progress.to_list()}, headers
+        # wait briefly so fast operations answer synchronously (reference's
+        # session wait inside getOrCreateUserTask)
+        try:
+            result = task.future.result(timeout=1.0)
+            return 200, _op_result_json(result), headers
+        except Exception:
+            pass
+        return 202, {"progress": task.progress.to_list(), "userTaskId": task.task_id}, headers
+
+    def post_rebalance(self, params):
+        dryrun = _qbool(params, "dryrun", True)
+        goal_ids = _goal_ids(params)
+        excluded = params.get("excluded_topics", [None])[0]
+        excluded_topics = excluded.split(",") if excluded else ()
+
+        def work(progress):
+            progress.add_step("WaitingForClusterModel")
+            progress.add_step("OptimizationForGoals")
+            return self.cc.rebalance(
+                dryrun=dryrun, goal_ids=goal_ids, excluded_topics=excluded_topics
+            )
+
+        return self._async_op("REBALANCE", params, work)
+
+    def post_add_broker(self, params):
+        ids = _qint_list(params, "brokerid")
+        dryrun = _qbool(params, "dryrun", True)
+        return self._async_op(
+            "ADD_BROKER", params, lambda p: self.cc.add_brokers(ids, dryrun=dryrun)
+        )
+
+    def post_remove_broker(self, params):
+        ids = _qint_list(params, "brokerid")
+        dryrun = _qbool(params, "dryrun", True)
+        return self._async_op(
+            "REMOVE_BROKER", params, lambda p: self.cc.remove_brokers(ids, dryrun=dryrun)
+        )
+
+    def post_demote_broker(self, params):
+        ids = _qint_list(params, "brokerid")
+        dryrun = _qbool(params, "dryrun", True)
+        return self._async_op(
+            "DEMOTE_BROKER", params, lambda p: self.cc.demote_brokers(ids, dryrun=dryrun)
+        )
+
+    def post_fix_offline_replicas(self, params):
+        dryrun = _qbool(params, "dryrun", True)
+        return self._async_op(
+            "FIX_OFFLINE_REPLICAS", params, lambda p: self.cc.fix_offline_replicas(dryrun=dryrun)
+        )
+
+    def post_topic_configuration(self, params):
+        pattern = params.get("topic", [".*"])[0]
+        rf = int(params.get("replication_factor", ["3"])[0])
+        dryrun = _qbool(params, "dryrun", True)
+        return self._async_op(
+            "TOPIC_CONFIGURATION",
+            params,
+            lambda p: self.cc.update_topic_replication_factor(pattern, rf, dryrun=dryrun),
+        )
+
+    def post_stop_proposal_execution(self, params):
+        self.cc.stop_execution()
+        return 200, {"message": "Proposal execution stopped."}, {}
+
+    def post_pause_sampling(self, params):
+        reason = params.get("reason", ["No reason provided"])[0]
+        self.cc.pause_sampling(reason)
+        return 200, {"message": f"Sampling paused: {reason}"}, {}
+
+    def post_resume_sampling(self, params):
+        reason = params.get("reason", ["No reason provided"])[0]
+        self.cc.resume_sampling(reason)
+        return 200, {"message": f"Sampling resumed: {reason}"}, {}
+
+    def post_rightsize(self, params):
+        if self.provisioner is None:
+            return 400, {"error": "no provisioner configured"}, {}
+        from cruise_control_tpu.analyzer.optimizer import ProvisionRecommendation
+
+        rec = ProvisionRecommendation(
+            status="UNDER_PROVISIONED",
+            violated_hard_goals=[],
+            message=(
+                f"operator rightsize request: brokers+={params.get('broker_number', ['0'])[0]} "
+                f"partitions={params.get('partition_count', ['-'])[0]}"
+            ),
+        )
+        result = self.provisioner.rightsize(rec)
+        return 200, {"state": result.state.value, "summary": result.summary}, {}
+
+    def post_remove_disks(self, params):
+        spec = params.get("brokerid_and_logdirs", [""])[0]
+        pairs = []
+        for part in spec.split(","):
+            if "-" in part:
+                b, logdir = part.split("-", 1)
+                pairs.append((int(b), logdir))
+        dryrun = _qbool(params, "dryrun", True)
+
+        def work(progress):
+            model = self.cc.cluster_model()
+            for b, logdir in pairs:
+                try:
+                    model.mark_disk_dead(b, logdir)
+                except ValueError:
+                    pass
+            return self.cc._optimize_and_maybe_execute(model, dryrun)
+
+        return self._async_op("REMOVE_DISKS", params, work)
+
+    def post_admin(self, params):
+        changed = {}
+        for action, enabled in (
+            ("enable_self_healing_for", True),
+            ("disable_self_healing_for", False),
+        ):
+            v = params.get(action, [None])[0]
+            if v and self.anomaly_manager is not None:
+                for name in v.split(","):
+                    t = AnomalyType[name.upper()]
+                    self.anomaly_manager.notifier.set_self_healing(t, enabled)
+                    changed[name] = enabled
+        conc = params.get("concurrent_partition_movements_per_broker", [None])[0]
+        if conc:
+            self.cc.executor.concurrency.set_per_broker_cap(None, int(conc))
+            changed["perBrokerConcurrency"] = int(conc)
+        return 200, {"updated": changed}, {}
+
+    def post_review(self, params):
+        approve = _qint_list(params, "approve")
+        discard = _qint_list(params, "discard")
+        reason = params.get("reason", [""])[0]
+        infos = self.purgatory.review(approve, discard, reason)
+        return 200, {"reviewed": [i.to_dict() for i in infos]}, {}
+
+    # -- dispatch ------------------------------------------------------------
+
+    def handle(
+        self, method: str, endpoint: str, params: Dict[str, List[str]], headers
+    ) -> Tuple[int, dict, Dict[str, str]]:
+        try:
+            user, role = self.security.authenticate(headers)
+        except AuthenticationError as e:
+            return 401, {"error": str(e)}, {}
+        if not self.security.authorize(role, endpoint, method):
+            return 403, {"error": f"role {role.name} may not {method} {endpoint}"}, {}
+
+        try:
+            if method == "GET":
+                if endpoint == "PERMISSIONS":
+                    status, body = self.get_permissions(params, role=role)
+                    return status, body, {}
+                fn = getattr(self, f"get_{endpoint.lower()}", None)
+                if fn is None:
+                    return 404, {"error": f"unknown endpoint {endpoint}"}, {}
+                status, body = fn(params)
+                return status, body, {}
+
+            # POST: two-step verification parks reviewable requests
+            if self.two_step and endpoint in REVIEWABLE:
+                review_id = params.get("review_id", [None])[0]
+                if review_id is None:
+                    info = self.purgatory.park(
+                        endpoint, {k: v for k, v in params.items()}, user or "anonymous"
+                    )
+                    return 202, {"reviewId": info.review_id, "status": info.status.value}, {}
+                claimed = self.purgatory.take_approved(int(review_id), endpoint)
+                if claimed is None:
+                    return 403, {"error": f"review {review_id} not approved for {endpoint}"}, {}
+                params = {**claimed.params, **{k: v for k, v in params.items() if k != "review_id"}}
+
+            fn = getattr(self, f"post_{endpoint.lower()}", None)
+            if fn is None:
+                return 404, {"error": f"unknown endpoint {endpoint}"}, {}
+            return fn(params)
+        except Exception as e:  # uniform error envelope (reference's error response)
+            return 500, {"error": f"{type(e).__name__}: {e}"}, {}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    app: CruiseControlApp = None  # set by make_server
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urlparse(self.path)
+        if not parsed.path.startswith(API_PREFIX):
+            self._respond(404, {"error": "not found"}, {})
+            return
+        endpoint = parsed.path[len(API_PREFIX):].strip("/").upper()
+        params = parse_qs(parsed.query)
+        if method == "POST" and self.headers.get("Content-Length"):
+            length = int(self.headers["Content-Length"])
+            body = self.rfile.read(length).decode()
+            for k, v in parse_qs(body).items():
+                params.setdefault(k, v)
+        valid = GET_ENDPOINTS if method == "GET" else POST_ENDPOINTS
+        if endpoint not in valid:
+            self._respond(404, {"error": f"unknown {method} endpoint {endpoint!r}"}, {})
+            return
+        status, body, headers = self.app.handle(method, endpoint, params, self.headers)
+        self._respond(status, body, headers)
+
+    def _respond(self, status: int, body: dict, headers: Dict[str, str]) -> None:
+        payload = json.dumps(body, default=str).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self) -> None:  # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._dispatch("POST")
+
+    def log_message(self, fmt, *args) -> None:  # quiet
+        pass
+
+
+def make_server(app: CruiseControlApp, host: str = "127.0.0.1", port: int = 9090):
+    handler = type("BoundHandler", (_Handler,), {"app": app})
+    return ThreadingHTTPServer((host, port), handler)

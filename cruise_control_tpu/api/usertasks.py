@@ -1,0 +1,133 @@
+"""Async user-task tracking.
+
+Counterpart of ``servlet/UserTaskManager.java:69`` (getOrCreateUserTask:222,
+markTaskExecutionBegan/Finished:397,422): a POST that needs background work gets a
+UUID and a 202 response carrying the ``User-Task-ID`` header; repeating the request
+(or polling with the task id) returns the current progress until the future
+completes, then the final response.  Completed tasks are retained for a
+configurable period per endpoint type.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cruise_control_tpu.api.progress import OperationProgress
+
+
+class TaskStatus(enum.Enum):
+    ACTIVE = "Active"
+    IN_EXECUTION = "InExecution"
+    COMPLETED = "Completed"
+    COMPLETED_WITH_ERROR = "CompletedWithError"
+
+
+@dataclasses.dataclass
+class UserTask:
+    task_id: str
+    endpoint: str
+    request_key: Tuple
+    progress: OperationProgress
+    future: Future
+    created_ms: int
+    status: TaskStatus = TaskStatus.ACTIVE
+
+    def to_dict(self) -> dict:
+        return {
+            "UserTaskId": self.task_id,
+            "RequestURL": self.endpoint,
+            "Status": self.status.value,
+            "StartMs": self.created_ms,
+            "Progress": self.progress.to_list(),
+        }
+
+
+class UserTaskManager:
+    def __init__(
+        self,
+        max_workers: int = 4,
+        completed_retention_ms: int = 6 * 3600 * 1000,
+        max_active_tasks: int = 25,
+    ) -> None:
+        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+        self._tasks: Dict[str, UserTask] = {}
+        self._by_key: Dict[Tuple, str] = {}
+        self._lock = threading.Lock()
+        self.completed_retention_ms = completed_retention_ms
+        self.max_active_tasks = max_active_tasks
+
+    def get_or_create(
+        self,
+        endpoint: str,
+        request_key: Tuple,
+        work: Callable[[OperationProgress], object],
+    ) -> UserTask:
+        """Dedupe by request key: re-submitting the same request returns the same
+        task (getOrCreateUserTask:222's session semantics, keyed by parameters)."""
+        with self._lock:
+            self._expire_locked()
+            existing_id = self._by_key.get(request_key)
+            if existing_id and existing_id in self._tasks:
+                return self._tasks[existing_id]
+            active = sum(
+                1 for t in self._tasks.values()
+                if t.status in (TaskStatus.ACTIVE, TaskStatus.IN_EXECUTION)
+            )
+            if active >= self.max_active_tasks:
+                raise RuntimeError("too many active user tasks")
+            task_id = str(uuid.uuid4())
+            progress = OperationProgress()
+            task = UserTask(
+                task_id=task_id,
+                endpoint=endpoint,
+                request_key=request_key,
+                progress=progress,
+                future=None,  # type: ignore[arg-type]
+                created_ms=int(time.time() * 1000),
+            )
+            self._tasks[task_id] = task
+            self._by_key[request_key] = task_id
+
+        def _run():
+            task.status = TaskStatus.IN_EXECUTION
+            try:
+                result = work(progress)
+                task.status = TaskStatus.COMPLETED
+                return result
+            except Exception:
+                task.status = TaskStatus.COMPLETED_WITH_ERROR
+                raise
+            finally:
+                progress.complete()
+
+        task.future = self._pool.submit(_run)
+        return task
+
+    def get(self, task_id: str) -> Optional[UserTask]:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def all_tasks(self) -> List[UserTask]:
+        with self._lock:
+            self._expire_locked()
+            return sorted(self._tasks.values(), key=lambda t: t.created_ms)
+
+    def _expire_locked(self) -> None:
+        now = int(time.time() * 1000)
+        expired = [
+            tid for tid, t in self._tasks.items()
+            if t.status in (TaskStatus.COMPLETED, TaskStatus.COMPLETED_WITH_ERROR)
+            and now - t.created_ms > self.completed_retention_ms
+        ]
+        for tid in expired:
+            t = self._tasks.pop(tid)
+            self._by_key.pop(t.request_key, None)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,76 @@
+"""Detector layer: anomaly detection and the self-healing loop.
+
+Counterpart of ``cruise-control/src/main/java/.../detector/`` (SURVEY §2.3).
+"""
+
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    AnomalyType,
+    BrokerFailures,
+    DiskFailures,
+    GoalViolations,
+    MaintenanceEvent,
+    MaintenanceEventType,
+    NotificationAction,
+    NotificationResult,
+    SlowBrokerAction,
+    SlowBrokers,
+    TopicReplicationFactorAnomaly,
+)
+from cruise_control_tpu.detector.detectors import (
+    BrokerFailureDetector,
+    Detector,
+    DiskFailureDetector,
+    GoalViolationDetector,
+    MaintenanceEventDetector,
+    SlowBrokerFinder,
+    TopicReplicationFactorAnomalyFinder,
+)
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager, AnomalyDetectorState
+from cruise_control_tpu.detector.notifier import (
+    AlertCallbackNotifier,
+    AnomalyNotifier,
+    NoopNotifier,
+    SelfHealingNotifier,
+)
+from cruise_control_tpu.detector.provisioner import (
+    BasicProvisioner,
+    CallbackProvisioner,
+    NoopProvisioner,
+    Provisioner,
+    ProvisionerResult,
+    ProvisionerState,
+)
+
+__all__ = [
+    "AlertCallbackNotifier",
+    "Anomaly",
+    "AnomalyDetectorManager",
+    "AnomalyDetectorState",
+    "AnomalyNotifier",
+    "AnomalyType",
+    "BasicProvisioner",
+    "BrokerFailureDetector",
+    "BrokerFailures",
+    "CallbackProvisioner",
+    "Detector",
+    "DiskFailureDetector",
+    "DiskFailures",
+    "GoalViolationDetector",
+    "GoalViolations",
+    "MaintenanceEvent",
+    "MaintenanceEventDetector",
+    "MaintenanceEventType",
+    "NoopNotifier",
+    "NoopProvisioner",
+    "NotificationAction",
+    "NotificationResult",
+    "Provisioner",
+    "ProvisionerResult",
+    "ProvisionerState",
+    "SelfHealingNotifier",
+    "SlowBrokerAction",
+    "SlowBrokerFinder",
+    "SlowBrokers",
+    "TopicReplicationFactorAnomalyFinder",
+]

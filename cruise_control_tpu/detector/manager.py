@@ -1,0 +1,194 @@
+"""AnomalyDetectorManager: scheduling, queueing, and the self-healing loop.
+
+Counterpart of ``detector/AnomalyDetectorManager.java`` (queue :73, startDetection
+:234-243, AnomalyHandlerTask :342, fixAnomalyInProgress :533): periodic detectors
+feed a priority queue; the handler consults the :class:`AnomalyNotifier` (IGNORE /
+CHECK(delay) / FIX) and invokes ``anomaly.fix_with(cruise_control)`` — the same
+optimize→execute pipeline user requests go through.  Tracks per-type counts,
+self-healing enable state, and mean time between anomalies for the STATE endpoint
+(AnomalyDetectorState, AnomalyMetrics/MeanTimeBetweenAnomaliesMs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    AnomalyType,
+    NotificationAction,
+)
+from cruise_control_tpu.detector.detectors import Detector
+from cruise_control_tpu.detector.notifier import AnomalyNotifier
+from cruise_control_tpu.executor.engine import OngoingExecutionError
+
+
+@dataclasses.dataclass
+class AnomalyDetectorState:
+    """STATE endpoint payload (AnomalyDetectorState.java)."""
+
+    self_healing_enabled: Dict[str, bool]
+    recent_anomalies: Dict[str, List[str]]
+    num_self_healing_started: int
+    num_self_healing_failed: int
+    mean_time_between_anomalies_ms: Dict[str, float]
+    queue_size: int
+
+
+class AnomalyDetectorManager:
+    def __init__(
+        self,
+        cruise_control,
+        notifier: AnomalyNotifier,
+        detectors: Sequence[Tuple[Detector, float]],
+        history_limit: int = 10,
+    ) -> None:
+        """``detectors``: (detector, interval_s) pairs (the reference schedules 5
+        periodic detectors + 1 continuous, :234-243)."""
+        self.cc = cruise_control
+        self.notifier = notifier
+        self.detectors = list(detectors)
+        self.history_limit = history_limit
+
+        self._queue: List[Anomaly] = []
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._recent: Dict[AnomalyType, List[Anomaly]] = {t: [] for t in AnomalyType}
+        self._detection_times: Dict[AnomalyType, List[int]] = {t: [] for t in AnomalyType}
+        self._checked: Dict[int, int] = {}   # anomaly_id -> not-before ms
+        self.num_self_healing_started = 0
+        self.num_self_healing_failed = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start_detection(self) -> None:
+        """Spawn detector schedules + the handler task (startDetection:234)."""
+        self._stop.clear()
+        for detector, interval_s in self.detectors:
+            t = threading.Thread(
+                target=self._detector_loop, args=(detector, interval_s), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        handler = threading.Thread(target=self._handler_loop, daemon=True)
+        handler.start()
+        self._threads.append(handler)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    # -- detection -----------------------------------------------------------
+
+    def _detector_loop(self, detector: Detector, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            self.run_detector_once(detector)
+
+    def run_detector_once(self, detector: Detector) -> int:
+        """One detection cycle (exposed for tests / synchronous drives)."""
+        try:
+            anomalies = detector.run()
+        except Exception:
+            return 0
+        for a in anomalies:
+            self._enqueue(a)
+        return len(anomalies)
+
+    def _enqueue(self, anomaly: Anomaly) -> None:
+        with self._cv:
+            heapq.heappush(self._queue, anomaly)
+            hist = self._recent[anomaly.anomaly_type]
+            hist.append(anomaly)
+            del hist[: -self.history_limit]
+            self._detection_times[anomaly.anomaly_type].append(anomaly.detected_ms)
+            self._cv.notify_all()
+
+    # -- handling ------------------------------------------------------------
+
+    def _handler_loop(self) -> None:
+        while not self._stop.is_set():
+            anomaly = self._next_anomaly(timeout_s=0.2)
+            if anomaly is not None:
+                self.handle_anomaly(anomaly)
+
+    def _next_anomaly(self, timeout_s: float) -> Optional[Anomaly]:
+        with self._cv:
+            if not self._queue:
+                self._cv.wait(timeout=timeout_s)
+            now = int(time.time() * 1000)
+            ready_idx = None
+            for i, a in enumerate(self._queue):
+                if self._checked.get(a.anomaly_id, 0) <= now:
+                    ready_idx = i
+                    break
+            if ready_idx is None:
+                return None
+            a = self._queue.pop(ready_idx)
+            heapq.heapify(self._queue)
+            return a
+
+    def handle_anomaly(self, anomaly: Anomaly) -> str:
+        """Notifier consult + fix (AnomalyHandlerTask :385-412 → :533).
+
+        Returns the action taken ("IGNORE" | "CHECK" | "FIXED" | "FIX_FAILED").
+        """
+        result = self.notifier.on_anomaly(anomaly)
+        if result.action is NotificationAction.IGNORE:
+            return "IGNORE"
+        if result.action is NotificationAction.CHECK:
+            with self._cv:
+                self._checked[anomaly.anomaly_id] = (
+                    int(time.time() * 1000) + result.delay_ms
+                )
+                heapq.heappush(self._queue, anomaly)
+            return "CHECK"
+        self.num_self_healing_started += 1
+        try:
+            anomaly.fix_result = anomaly.fix_with(self.cc)
+            return "FIXED"
+        except OngoingExecutionError:
+            # retry after the running execution finishes (reference re-queues)
+            with self._cv:
+                self._checked[anomaly.anomaly_id] = int(time.time() * 1000) + 1000
+                heapq.heappush(self._queue, anomaly)
+            return "CHECK"
+        except Exception:
+            self.num_self_healing_failed += 1
+            return "FIX_FAILED"
+
+    # -- state ---------------------------------------------------------------
+
+    def _mtba(self) -> Dict[str, float]:
+        out = {}
+        for t, times in self._detection_times.items():
+            if len(times) >= 2:
+                gaps = [b - a for a, b in zip(times, times[1:])]
+                out[t.name] = sum(gaps) / len(gaps)
+            else:
+                out[t.name] = float("inf")
+        return out
+
+    def state(self) -> AnomalyDetectorState:
+        with self._cv:
+            return AnomalyDetectorState(
+                self_healing_enabled={
+                    t.name: v for t, v in self.notifier.self_healing_enabled.items()
+                },
+                recent_anomalies={
+                    t.name: [a.description() for a in hist]
+                    for t, hist in self._recent.items()
+                },
+                num_self_healing_started=self.num_self_healing_started,
+                num_self_healing_failed=self.num_self_healing_failed,
+                mean_time_between_anomalies_ms=self._mtba(),
+                queue_size=len(self._queue),
+            )

@@ -1,0 +1,69 @@
+"""Provisioner SPI: act on under/over-provisioning verdicts.
+
+Counterpart of ``detector/Provisioner.java`` + ``BasicProvisioner`` /
+``PartitionProvisioner`` / ``NoopProvisioner``: when the optimizer reports an
+UNDER_PROVISIONED verdict (hard goals unsatisfiable), the goal-violation flow calls
+``rightsize`` (GoalViolationDetector.java:227).  Real capacity actions are
+deployment-specific; :class:`BasicProvisioner` records the recommendation and
+reports COMPLETED_WITH_ERROR like the reference's placeholder, while
+:class:`CallbackProvisioner` delegates to user code (e.g. a cluster autoscaler).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, List, Optional
+
+from cruise_control_tpu.analyzer.optimizer import ProvisionRecommendation
+
+
+class ProvisionerState(enum.Enum):
+    COMPLETED = "COMPLETED"
+    COMPLETED_WITH_ERROR = "COMPLETED_WITH_ERROR"
+    IN_PROGRESS = "IN_PROGRESS"
+
+
+@dataclasses.dataclass
+class ProvisionerResult:
+    state: ProvisionerState
+    summary: str
+
+
+class Provisioner:
+    def rightsize(self, recommendation: ProvisionRecommendation) -> ProvisionerResult:
+        raise NotImplementedError
+
+
+class NoopProvisioner(Provisioner):
+    def rightsize(self, recommendation) -> ProvisionerResult:
+        return ProvisionerResult(ProvisionerState.COMPLETED, "noop")
+
+
+class BasicProvisioner(Provisioner):
+    """Records recommendations; actual broker/disk changes are out of scope
+    (BasicProvisioner.java behaves the same way)."""
+
+    def __init__(self) -> None:
+        self.history: List[ProvisionRecommendation] = []
+
+    def rightsize(self, recommendation) -> ProvisionerResult:
+        self.history.append(recommendation)
+        return ProvisionerResult(
+            ProvisionerState.COMPLETED_WITH_ERROR,
+            f"recorded recommendation: {recommendation.message}",
+        )
+
+
+class CallbackProvisioner(Provisioner):
+    def __init__(
+        self, callback: Callable[[ProvisionRecommendation], bool]
+    ) -> None:
+        self.callback = callback
+
+    def rightsize(self, recommendation) -> ProvisionerResult:
+        ok = self.callback(recommendation)
+        return ProvisionerResult(
+            ProvisionerState.COMPLETED if ok else ProvisionerState.COMPLETED_WITH_ERROR,
+            recommendation.message,
+        )

@@ -1,0 +1,322 @@
+"""CruiseControl facade: the one object that wires every layer together.
+
+Counterpart of ``KafkaCruiseControl.java:78`` (wiring :112-129): owns the
+LoadMonitor, the GoalOptimizer (TPU solver), the Executor, and exposes the
+operations the API layer and the self-healing runnables invoke — cluster model
+access, optimization (dry-run or executed), broker add/remove/demote, offline-replica
+fix, pause/resume, stop, state.  The async/user-task machinery lives in the API
+layer; this facade is synchronous.
+
+The reference's per-operation runnables (``RebalanceRunnable.java:110``,
+``RemoveBrokersRunnable``, …) collapse into the ``*_proposals``/``rebalance``-style
+methods here: each builds a fresh model under the generation semaphore, runs the
+solver with operation-specific context, and optionally executes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer, OptimizerResult
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.backend.base import ClusterBackend
+from cruise_control_tpu.executor import Executor, ExecutionSummary
+from cruise_control_tpu.model.cluster import BrokerState, ClusterModel
+from cruise_control_tpu.monitor import LoadMonitor, ModelCompletenessRequirements
+
+
+@dataclasses.dataclass
+class OperationResult:
+    """What an optimize-style operation returns (OptimizerResult + execution)."""
+
+    optimizer_result: OptimizerResult
+    execution: Optional[ExecutionSummary]
+    dryrun: bool
+
+
+class CruiseControl:
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        monitor: LoadMonitor,
+        executor: Executor,
+        goal_ids: Sequence[int] = G.DEFAULT_GOAL_ORDER,
+        hard_ids: Sequence[int] = G.HARD_GOALS,
+        constraint: Optional[BalancingConstraint] = None,
+        enable_heavy_goals: bool = True,
+    ) -> None:
+        self.backend = backend
+        self.monitor = monitor
+        self.executor = executor
+        self.goal_ids = tuple(goal_ids)
+        self.hard_ids = tuple(hard_ids)
+        self.constraint = constraint
+        self.enable_heavy_goals = enable_heavy_goals
+        self._start_time = time.time()
+
+    # -- lifecycle (KafkaCruiseControl.startUp) ------------------------------
+
+    def start(self, sampling_interval_ms: int = 0) -> None:
+        self.monitor.start(sampling_interval_ms=sampling_interval_ms)
+
+    def shutdown(self) -> None:
+        self.monitor.shutdown()
+
+    # -- model access --------------------------------------------------------
+
+    def cluster_model(
+        self,
+        requirements: ModelCompletenessRequirements = ModelCompletenessRequirements(),
+    ) -> ClusterModel:
+        return self.monitor.cluster_model(requirements=requirements)
+
+    def _optimizer(self, goal_ids: Optional[Sequence[int]]) -> GoalOptimizer:
+        return GoalOptimizer(
+            goal_ids=tuple(goal_ids) if goal_ids is not None else self.goal_ids,
+            hard_ids=self.hard_ids,
+            enable_heavy_goals=self.enable_heavy_goals,
+        )
+
+    def _context(
+        self,
+        model: ClusterModel,
+        maps,
+        state,
+        excluded_topics: Sequence[str] = (),
+        excluded_brokers_for_leadership: Sequence[int] = (),
+        excluded_brokers_for_replica_move: Sequence[int] = (),
+        only_move_immigrants: bool = False,
+        triggered_by_violation: bool = False,
+    ) -> GoalContext:
+        topic_ids = [
+            maps.topic_index[t] for t in excluded_topics if t in maps.topic_index
+        ]
+        bl = [
+            maps.broker_index[b]
+            for b in excluded_brokers_for_leadership
+            if b in maps.broker_index
+        ]
+        br = [
+            maps.broker_index[b]
+            for b in excluded_brokers_for_replica_move
+            if b in maps.broker_index
+        ]
+        return GoalContext.build(
+            state.num_topics,
+            state.num_brokers,
+            constraint=self.constraint,
+            excluded_topic_ids=topic_ids,
+            excluded_brokers_for_leadership=bl,
+            excluded_brokers_for_replica_move=br,
+            only_move_immigrants=only_move_immigrants,
+            triggered_by_violation=triggered_by_violation,
+        )
+
+    # -- operations (the runnables' workWithClusterModel bodies) -------------
+
+    def _optimize_and_maybe_execute(
+        self,
+        model: ClusterModel,
+        dryrun: bool,
+        goal_ids: Optional[Sequence[int]] = None,
+        **ctx_kw,
+    ) -> OperationResult:
+        state, maps = model.to_arrays()
+        ctx = self._context(model, maps, state, **ctx_kw)
+        final, result = self._optimizer(goal_ids).optimize(state, ctx, maps=maps)
+        execution = None
+        if not dryrun and result.proposals:
+            execution = self.executor.execute_proposals(result.proposals)
+        return OperationResult(result, execution, dryrun)
+
+    def rebalance(
+        self,
+        dryrun: bool = True,
+        goal_ids: Optional[Sequence[int]] = None,
+        excluded_topics: Sequence[str] = (),
+        triggered_by_violation: bool = False,
+        requirements: ModelCompletenessRequirements = ModelCompletenessRequirements(),
+    ) -> OperationResult:
+        """POST /rebalance (RebalanceRunnable.java:110)."""
+        model = self.cluster_model(requirements)
+        return self._optimize_and_maybe_execute(
+            model, dryrun, goal_ids,
+            excluded_topics=excluded_topics,
+            triggered_by_violation=triggered_by_violation,
+        )
+
+    def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True, **kw) -> OperationResult:
+        """POST /add_broker: new brokers receive load (AddBrokersRunnable).
+
+        The new brokers are marked NEW; only immigrant moves onto them are
+        proposed (onlyMoveImmigrantReplicas semantics relaxed: the distribution
+        goals pull load toward the under-loaded newcomers)."""
+        model = self.cluster_model()
+        for b in broker_ids:
+            if b in model.brokers():
+                model.set_broker_state(b, BrokerState.NEW)
+        return self._optimize_and_maybe_execute(model, dryrun, **kw)
+
+    def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = True, **kw) -> OperationResult:
+        """POST /remove_broker: drain all replicas off the brokers
+        (RemoveBrokersRunnable — also the BrokerFailures fix)."""
+        model = self.cluster_model()
+        for b in broker_ids:
+            if b in model.brokers():
+                model.set_broker_state(b, BrokerState.DEAD)
+        return self._optimize_and_maybe_execute(model, dryrun, **kw)
+
+    def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = True, **kw) -> OperationResult:
+        """POST /demote_broker: move leadership (and preferred-leader slots) off
+        the brokers (DemoteBrokerRunnable; SlowBrokers DEMOTE fix)."""
+        model = self.cluster_model()
+        for b in broker_ids:
+            if b in model.brokers():
+                model.set_broker_state(b, BrokerState.DEMOTED)
+        return self._optimize_and_maybe_execute(
+            model, dryrun,
+            goal_ids=(G.LEADER_REPLICA_DIST, G.LEADER_BYTES_IN_DIST),
+            excluded_brokers_for_leadership=list(broker_ids),
+        )
+
+    def fix_offline_replicas(self, dryrun: bool = True, **kw) -> OperationResult:
+        """POST /fix_offline_replicas (FixOfflineReplicasRunnable; DiskFailures fix).
+
+        The optimizer's offline pre-phase relocates replicas on dead brokers and
+        dead disks; the goal list then re-balances."""
+        model = self.cluster_model()
+        return self._optimize_and_maybe_execute(model, dryrun, **kw)
+
+    def update_topic_replication_factor(
+        self,
+        topic_pattern,
+        target_rf: int,
+        dryrun: bool = True,
+    ) -> OperationResult:
+        """POST /topic_configuration: change matching topics to the target RF
+        (UpdateTopicConfigurationRunnable / TopicReplicationFactorAnomaly fix).
+
+        RF increase adds follower replicas on rack-aware least-loaded brokers; RF
+        decrease strips trailing non-leader replicas.  Proposals are built directly
+        (no goal optimization) and executed unless ``dryrun``.
+        """
+        import re
+
+        from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+
+        pattern = re.compile(topic_pattern)
+        model = self.cluster_model()
+        state, maps = model.to_arrays()
+        counts = {b: 0 for b in model.brokers()}
+        rack_of = {}
+        for b in model.brokers():
+            info = self.backend.describe_cluster().brokers[b]
+            rack_of[b] = info.rack
+        for tp, brokers in model.replica_distribution().items():
+            for b in brokers:
+                counts[b] += 1
+
+        proposals: List = []
+        for tp, brokers in sorted(model.replica_distribution().items()):
+            if not pattern.fullmatch(tp[0]):
+                continue
+            leader = model.leader_of(tp)
+            new = list(brokers)
+            if len(new) < target_rf:
+                used_racks = {rack_of[b] for b in new}
+                candidates = sorted(
+                    (b for b in model.brokers() if b not in new),
+                    key=lambda b: (rack_of[b] in used_racks, counts[b]),
+                )
+                for b in candidates[: target_rf - len(new)]:
+                    new.append(b)
+                    counts[b] += 1
+                    used_racks.add(rack_of[b])
+            elif len(new) > target_rf:
+                removable = [b for b in reversed(new) if b != leader]
+                for b in removable[: len(new) - target_rf]:
+                    new.remove(b)
+                    counts[b] -= 1
+            if new == list(brokers):
+                continue
+            ordered = [leader] + [b for b in new if b != leader]
+            proposals.append(
+                ExecutionProposal(
+                    tp=tp,
+                    partition_size=0.0,
+                    old_leader=leader,
+                    old_replicas=tuple(brokers),
+                    new_replicas=tuple(ordered),
+                )
+            )
+
+        execution = None
+        if not dryrun and proposals:
+            execution = self.executor.execute_proposals(proposals)
+        empty = OptimizerResult(
+            goal_reports=[], violations_before={}, violations_after={},
+            stats_before={}, stats_after={}, proposals=proposals,
+            provision=None, total_moves=len(proposals), duration_s=0.0,
+        )
+        return OperationResult(empty, execution, dryrun)
+
+    def train_cpu_model(self, from_ms: int = 0, to_ms: Optional[int] = None) -> bool:
+        """GET /train: fit the linear CPU model from broker metric history.
+
+        Counterpart of the TRAIN endpoint / ``LinearRegressionModelParameters``:
+        least-squares CPU ≈ a·leader_bytes_in + b·leader_bytes_out +
+        c·replication_bytes_in over the aggregated broker windows.  The fitted
+        weights replace the static defaults used to derive follower CPU
+        (ModelUtils.java's a/b/c heuristic).
+        """
+        import numpy as np
+
+        from cruise_control_tpu.model.model_utils import CpuModelWeights
+
+        hist = self.monitor.broker_metric_history()
+        if hist is None:
+            return False
+        values, brokers, metric_def = hist
+        ids = {n: metric_def.metric_info(n).id for n in
+               ("CPU_USAGE", "LEADER_BYTES_IN", "LEADER_BYTES_OUT",
+                "REPLICATION_BYTES_IN_RATE")}
+        flat = values.reshape(-1, values.shape[-1])
+        y = flat[:, ids["CPU_USAGE"]]
+        X = flat[:, [ids["LEADER_BYTES_IN"], ids["LEADER_BYTES_OUT"],
+                     ids["REPLICATION_BYTES_IN_RATE"]]]
+        keep = (y > 0) & (X.sum(axis=1) > 0)
+        if keep.sum() < 3:
+            return False
+        coef, *_ = np.linalg.lstsq(X[keep], y[keep], rcond=None)
+        if not np.all(np.isfinite(coef)):
+            return False
+        total = float(np.abs(coef).sum())
+        if total <= 0:
+            return False
+        a, b, c = (float(abs(x)) / total for x in coef)
+        self.trained_cpu_weights = CpuModelWeights(a, b, c)
+        return True
+
+    # -- pass-throughs -------------------------------------------------------
+
+    def stop_execution(self) -> None:
+        self.executor.stop_execution()
+
+    def pause_sampling(self, reason: str) -> None:
+        self.monitor.pause_sampling(reason)
+
+    def resume_sampling(self, reason: str) -> None:
+        self.monitor.resume_sampling(reason)
+
+    # -- state (STATE endpoint substrate) ------------------------------------
+
+    def state(self) -> Dict[str, object]:
+        ms = self.monitor.state()
+        return {
+            "MonitorState": dataclasses.asdict(ms),
+            "ExecutorState": {"state": self.executor.state},
+            "uptime_s": time.time() - self._start_time,
+        }

@@ -324,6 +324,18 @@ class LoadMonitor:
             )
         return out
 
+    def broker_metric_history(self):
+        """(values f32[E, W, M], broker_ids, metric_def) for anomaly finders
+        (the broker-aggregator view SlowBrokerFinder consumes); None when no
+        stable windows exist yet."""
+        try:
+            vae, _ = self._broker_agg.aggregate(
+                options=AggregationOptions(include_invalid_entities=True)
+            )
+        except NotEnoughValidWindowsError:
+            return None
+        return vae.values, list(vae.entities), self._broker_agg.metric_def
+
     # -- state --------------------------------------------------------------
 
     def state(self) -> LoadMonitorState:

@@ -1,0 +1,201 @@
+"""Detector + self-healing tests against the fake backend.
+
+Mirrors the reference's detector test tier (``AnomalyDetectorManagerTest``,
+``SlowBrokerFinderTest``) plus the broker-failure integration scenario
+(``BrokerFailureIntegrationTest.java:38``: kill broker → self-healing drains it) —
+run in-process on :class:`FakeClusterBackend` instead of embedded Kafka.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.backend import FakeClusterBackend
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.detector import (
+    AnomalyDetectorManager,
+    AnomalyNotifier,
+    AnomalyType,
+    BrokerFailureDetector,
+    DiskFailureDetector,
+    GoalViolationDetector,
+    MaintenanceEvent,
+    MaintenanceEventDetector,
+    MaintenanceEventType,
+    NoopNotifier,
+    SelfHealingNotifier,
+    TopicReplicationFactorAnomalyFinder,
+)
+from cruise_control_tpu.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor import (
+    BackendMetricSampler,
+    LoadMonitor,
+    StaticCapacityResolver,
+)
+
+CAPACITY = {
+    Resource.CPU: 100.0,
+    Resource.NW_IN: 1e6,
+    Resource.NW_OUT: 1e6,
+    Resource.DISK: 1e7,
+}
+WINDOW_MS = 60_000
+
+
+def build_cc(num_brokers=6, partitions=24, rf=2, skew=3):
+    backend = FakeClusterBackend()
+    for b in range(num_brokers):
+        backend.add_broker(b, rack=str(b % 3))
+    for p in range(partitions):
+        reps = [(p % skew), (p % skew + 1) % num_brokers]
+        backend.create_partition(("T", p), reps, load=[1.5, 4e3, 6e3, 3e4])
+    monitor = LoadMonitor(
+        backend,
+        BackendMetricSampler(backend),
+        StaticCapacityResolver(CAPACITY),
+        num_windows=4,
+        window_ms=WINDOW_MS,
+    )
+    executor = Executor(
+        backend,
+        pause_sampling=monitor.pause_sampling,
+        resume_sampling=monitor.resume_sampling,
+    )
+    cc = CruiseControl(backend, monitor, executor)
+    cc.start()
+    for w in range(6):
+        monitor.sample_once(now_ms=(w + 1) * WINDOW_MS)
+    return backend, monitor, cc
+
+
+class TestBrokerFailureDetector:
+    def test_detects_and_persists_failure_times(self, tmp_path):
+        backend, monitor, cc = build_cc()
+        path = str(tmp_path / "failed_brokers.json")
+        det = BrokerFailureDetector(backend, path, now_ms=lambda: 12345)
+        assert det.run() == []
+        backend.kill_broker(1)
+        anomalies = det.run()
+        assert len(anomalies) == 1
+        assert anomalies[0].failed_brokers == {1: 12345}
+        # a fresh detector instance (restart) recalls the failure time
+        det2 = BrokerFailureDetector(backend, path, now_ms=lambda: 99999)
+        anomalies2 = det2.run()
+        assert anomalies2[0].failed_brokers == {1: 12345}
+
+    def test_recovered_broker_cleared(self, tmp_path):
+        backend, monitor, cc = build_cc()
+        det = BrokerFailureDetector(backend, str(tmp_path / "fb.json"))
+        backend.kill_broker(2)
+        assert det.run()
+        backend.restart_broker(2)
+        assert det.run() == []
+
+
+class TestSelfHealingLoop:
+    def test_broker_failure_grace_period(self, tmp_path):
+        """Before the alert threshold the notifier defers (CHECK); past the
+        self-healing threshold it fixes (SelfHealingNotifier.onBrokerFailure:228)."""
+        backend, monitor, cc = build_cc()
+        clock = {"now": 1_000_000}
+        notifier = SelfHealingNotifier(
+            broker_failure_alert_threshold_ms=10_000,
+            broker_failure_self_healing_threshold_ms=20_000,
+            now_ms=lambda: clock["now"],
+        )
+        det = BrokerFailureDetector(
+            backend, str(tmp_path / "fb.json"), now_ms=lambda: clock["now"]
+        )
+        manager = AnomalyDetectorManager(cc, notifier, detectors=[])
+        backend.kill_broker(1)
+        (anomaly,) = det.run()
+        assert manager.handle_anomaly(anomaly) == "CHECK"
+        clock["now"] += 25_000
+        (anomaly2,) = det.run()
+        assert manager.handle_anomaly(anomaly2) == "FIXED"
+        # broker 1 drained
+        topics = backend.describe_topics()
+        for infos in topics.values():
+            for i in infos:
+                assert 1 not in i.replicas, f"{i.tp} still on dead broker"
+
+    def test_noop_notifier_ignores(self, tmp_path):
+        backend, monitor, cc = build_cc()
+        det = BrokerFailureDetector(backend, str(tmp_path / "fb.json"))
+        manager = AnomalyDetectorManager(cc, NoopNotifier(), detectors=[])
+        backend.kill_broker(1)
+        (anomaly,) = det.run()
+        assert manager.handle_anomaly(anomaly) == "IGNORE"
+        assert manager.num_self_healing_started == 0
+
+
+class TestDiskFailure:
+    def test_offline_logdir_detected(self):
+        backend = FakeClusterBackend()
+        backend.add_broker(0, rack="0", logdirs={"/d0": 1e12, "/d1": 1e12})
+        backend.add_broker(1, rack="1")
+        backend.kill_logdir(0, "/d1")
+        det = DiskFailureDetector(backend)
+        (anomaly,) = det.run()
+        assert anomaly.failed_disks == {0: ["/d1"]}
+
+
+class TestGoalViolationDetector:
+    def test_skewed_cluster_reports_violations_and_balancedness(self):
+        backend, monitor, cc = build_cc(skew=2)  # heavy skew on brokers 0-1
+        det = GoalViolationDetector(cc)
+        anomalies = det.run()
+        # the skewed start must violate at least the distribution goals
+        assert anomalies and anomalies[0].violated_goals
+        assert det.balancedness_score < 1.0
+
+    def test_goal_violation_fix_rebalances(self):
+        backend, monitor, cc = build_cc(skew=2)
+        det = GoalViolationDetector(cc)
+        manager = AnomalyDetectorManager(cc, AnomalyNotifier(), detectors=[])
+        (anomaly,) = det.run()
+        assert manager.handle_anomaly(anomaly) == "FIXED"
+        assert anomaly.fix_result.execution is not None
+        # re-detection after the fix finds fewer violations
+        anomalies_after = det.run()
+        before = len(anomaly.violated_goals)
+        after = len(anomalies_after[0].violated_goals) if anomalies_after else 0
+        assert after < before
+
+
+class TestTopicAnomaly:
+    def test_rf_mismatch_detected(self):
+        backend = FakeClusterBackend()
+        for b in range(3):
+            backend.add_broker(b, rack=str(b))
+        backend.create_partition(("good", 0), [0, 1, 2], load=[1, 1, 1, 1])
+        backend.create_partition(("bad", 0), [0], load=[1, 1, 1, 1])
+        det = TopicReplicationFactorAnomalyFinder(backend, target_rf=3)
+        (anomaly,) = det.run()
+        assert anomaly.bad_topics == {"bad": 1}
+
+
+class TestMaintenanceEvents:
+    def test_dedupe_and_fix(self):
+        backend, monitor, cc = build_cc()
+        det = MaintenanceEventDetector()
+        e1 = MaintenanceEvent(event_type=MaintenanceEventType.REBALANCE)
+        e2 = MaintenanceEvent(event_type=MaintenanceEventType.REBALANCE)
+        det.submit(e1)
+        det.submit(e2)
+        out = det.run()
+        assert len(out) == 1  # idempotence cache dedupes
+
+
+class TestManagerState:
+    def test_state_reporting(self, tmp_path):
+        backend, monitor, cc = build_cc()
+        notifier = SelfHealingNotifier()
+        det = BrokerFailureDetector(backend, str(tmp_path / "fb.json"))
+        manager = AnomalyDetectorManager(cc, notifier, detectors=[(det, 60.0)])
+        backend.kill_broker(1)
+        manager.run_detector_once(det)
+        st = manager.state()
+        assert st.queue_size == 1
+        assert st.recent_anomalies["BROKER_FAILURE"]
+        assert st.self_healing_enabled["GOAL_VIOLATION"] is True
