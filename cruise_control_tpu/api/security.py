@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import base64
 import enum
+import hmac
 from typing import Dict, Mapping, Optional, Tuple
 
 
@@ -75,6 +76,10 @@ class BasicSecurityProvider(SecurityProvider):
         except Exception as e:
             raise AuthenticationError("malformed credentials") from e
         entry = self.users.get(user)
-        if entry is None or entry[0] != password:
+        # constant-time comparison; compare against a dummy when the user is
+        # unknown so lookup failures are not timing-distinguishable
+        expected = entry[0] if entry is not None else ""
+        ok = hmac.compare_digest(expected.encode(), password.encode())
+        if entry is None or not ok:
             raise AuthenticationError("bad credentials")
         return user, entry[1]

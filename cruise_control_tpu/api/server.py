@@ -451,7 +451,10 @@ class CruiseControlApp:
                 claimed = self.purgatory.take_approved(int(review_id), endpoint)
                 if claimed is None:
                     return 403, {"error": f"review {review_id} not approved for {endpoint}"}, {}
-                params = {**claimed.params, **{k: v for k, v in params.items() if k != "review_id"}}
+                # Execute the stored approved parameters VERBATIM (the reference's
+                # Purgatory.submit uses the parked RequestInfo's parameters; letting
+                # the submitter merge new params post-approval would bypass review).
+                params = dict(claimed.params)
 
             fn = getattr(self, f"post_{endpoint.lower()}", None)
             if fn is None:

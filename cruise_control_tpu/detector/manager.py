@@ -109,7 +109,9 @@ class AnomalyDetectorManager:
             hist = self._recent[anomaly.anomaly_type]
             hist.append(anomaly)
             del hist[: -self.history_limit]
-            self._detection_times[anomaly.anomaly_type].append(anomaly.detected_ms)
+            times = self._detection_times[anomaly.anomaly_type]
+            times.append(anomaly.detected_ms)
+            del times[: -max(self.history_limit, 100)]
             self._cv.notify_all()
 
     # -- handling ------------------------------------------------------------
@@ -131,9 +133,22 @@ class AnomalyDetectorManager:
                     ready_idx = i
                     break
             if ready_idx is None:
+                if self._queue:
+                    # everything queued is CHECK-delayed: sleep until the
+                    # earliest not-before time (or a new enqueue) rather than
+                    # returning immediately and busy-spinning in the handler
+                    earliest = min(
+                        self._checked.get(a.anomaly_id, 0) for a in self._queue
+                    )
+                    delay_s = min(max((earliest - now) / 1000.0, 0.0), timeout_s)
+                    if delay_s > 0:
+                        self._cv.wait(timeout=delay_s)
                 return None
             a = self._queue.pop(ready_idx)
             heapq.heapify(self._queue)
+            # prune the not-before entry: re-queues write a fresh one, and
+            # leaving stale ids would grow the map for the process lifetime
+            self._checked.pop(a.anomaly_id, None)
             return a
 
     def handle_anomaly(self, anomaly: Anomaly) -> str:

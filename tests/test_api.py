@@ -1,0 +1,212 @@
+"""API-layer tests: dispatch, two-step verification, auth, user tasks.
+
+The reference's servlet tier is tested via parameter/response tests and the
+integration harness (``CruiseControlIntegrationTestHarness.java:17``); here we
+drive :class:`CruiseControlApp.handle` directly against the fake backend, plus
+real-HTTP round-trips via ``make_server``.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu.api.security import (
+    AuthenticationError,
+    BasicSecurityProvider,
+    Role,
+)
+from cruise_control_tpu.api.server import CruiseControlApp
+from cruise_control_tpu.backend import FakeClusterBackend
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor import (
+    BackendMetricSampler,
+    LoadMonitor,
+    StaticCapacityResolver,
+)
+
+CAPACITY = {
+    Resource.CPU: 100.0,
+    Resource.NW_IN: 1e6,
+    Resource.NW_OUT: 1e6,
+    Resource.DISK: 1e7,
+}
+WINDOW_MS = 60_000
+
+
+def build_app(num_brokers=4, partitions=12, **app_kw) -> CruiseControlApp:
+    backend = FakeClusterBackend()
+    for b in range(num_brokers):
+        backend.add_broker(b, rack=str(b % 2))
+    for p in range(partitions):
+        reps = [p % 2, (p % 2 + 1) % num_brokers]
+        backend.create_partition(("T", p), reps, load=[1.5, 4e3, 6e3, 3e4])
+    monitor = LoadMonitor(
+        backend,
+        BackendMetricSampler(backend),
+        StaticCapacityResolver(CAPACITY),
+        num_windows=4,
+        window_ms=WINDOW_MS,
+    )
+    executor = Executor(
+        backend,
+        pause_sampling=monitor.pause_sampling,
+        resume_sampling=monitor.resume_sampling,
+    )
+    cc = CruiseControl(backend, monitor, executor)
+    cc.start()
+    for w in range(6):
+        monitor.sample_once(now_ms=(w + 1) * WINDOW_MS)
+    return CruiseControlApp(cc, **app_kw)
+
+
+class TestTwoStepVerification:
+    def test_approved_params_execute_verbatim(self):
+        """A submitter must not be able to alter parameters after approval:
+        the executed request uses the parked params, not the resubmission's
+        (reference Purgatory executes the stored RequestInfo verbatim)."""
+        app = build_app(two_step_verification=True)
+        # park a dryrun rebalance
+        status, body, _ = app.handle(
+            "POST", "REBALANCE", {"dryrun": ["true"]}, {}
+        )
+        assert status == 202 and "reviewId" in body
+        rid = body["reviewId"]
+        app.purgatory.review(approve_ids=[rid])
+        # resubmit attempting to flip dryrun to false
+        status, body, _ = app.handle(
+            "POST",
+            "REBALANCE",
+            {"review_id": [str(rid)], "dryrun": ["false"]},
+            {},
+        )
+        if status == 202:  # long first compile: wait on the user task
+            task = app.user_tasks.get(body["userTaskId"])
+            op = task.future.result(timeout=600)
+            assert op.dryrun is True             # approved value won
+            assert op.execution is None          # nothing was executed
+        else:
+            assert status == 200
+            assert body["dryrun"] is True
+            assert body["execution"] is None
+
+    def test_unapproved_review_id_rejected(self):
+        app = build_app(two_step_verification=True)
+        status, body, _ = app.handle("POST", "REBALANCE", {"dryrun": ["true"]}, {})
+        rid = body["reviewId"]
+        # not approved yet
+        status, body, _ = app.handle(
+            "POST", "REBALANCE", {"review_id": [str(rid)]}, {}
+        )
+        assert status == 403
+
+    def test_review_id_single_use(self):
+        app = build_app(two_step_verification=True)
+        _, body, _ = app.handle("POST", "REBALANCE", {"dryrun": ["true"]}, {})
+        rid = body["reviewId"]
+        app.purgatory.review(approve_ids=[rid])
+        status, _, _ = app.handle("POST", "REBALANCE", {"review_id": [str(rid)]}, {})
+        assert status in (200, 202)   # submitted (maybe still computing)
+        status, _, _ = app.handle("POST", "REBALANCE", {"review_id": [str(rid)]}, {})
+        assert status == 403
+
+
+class TestBasicAuth:
+    def _headers(self, user, password):
+        import base64
+
+        token = base64.b64encode(f"{user}:{password}".encode()).decode()
+        return {"Authorization": f"Basic {token}"}
+
+    def test_good_and_bad_credentials(self):
+        provider = BasicSecurityProvider({"alice": ("s3cret", Role.ADMIN)})
+        user, role = provider.authenticate(self._headers("alice", "s3cret"))
+        assert user == "alice" and role is Role.ADMIN
+        with pytest.raises(AuthenticationError):
+            provider.authenticate(self._headers("alice", "wrong"))
+        with pytest.raises(AuthenticationError):
+            provider.authenticate(self._headers("mallory", "s3cret"))
+        with pytest.raises(AuthenticationError):
+            provider.authenticate({})
+
+    def test_role_enforcement_in_dispatch(self):
+        app = build_app(
+            security=BasicSecurityProvider(
+                {
+                    "viewer": ("v", Role.VIEWER),
+                    "admin": ("a", Role.ADMIN),
+                }
+            )
+        )
+        status, _, _ = app.handle("GET", "STATE", {}, self._headers("viewer", "v"))
+        assert status == 200
+        status, _, _ = app.handle(
+            "POST", "PAUSE_SAMPLING", {}, self._headers("viewer", "v")
+        )
+        assert status == 403
+        status, _, _ = app.handle(
+            "POST", "PAUSE_SAMPLING", {}, self._headers("admin", "a")
+        )
+        assert status == 200
+        status, _, _ = app.handle("GET", "STATE", {}, self._headers("admin", "bad"))
+        assert status == 401
+
+
+class TestAnomalyQueueWait:
+    def test_check_delayed_queue_sleeps_instead_of_spinning(self):
+        """When every queued anomaly is CHECK-delayed, _next_anomaly must block
+        (up to its timeout) instead of returning immediately — otherwise the
+        handler loop busy-spins (ADVICE r1 manager.py finding)."""
+        from cruise_control_tpu.detector import AnomalyDetectorManager, NoopNotifier
+        from cruise_control_tpu.detector.anomalies import Anomaly, AnomalyType
+
+        class _A(Anomaly):
+            anomaly_type = AnomalyType.GOAL_VIOLATION
+
+            def description(self):
+                return "test"
+
+            def fix_with(self, cc):
+                return None
+
+        mgr = AnomalyDetectorManager(None, NoopNotifier(), detectors=[])
+        a = _A()
+        mgr._enqueue(a)
+        mgr._checked[a.anomaly_id] = int(time.time() * 1000) + 60_000
+        t0 = time.monotonic()
+        got = mgr._next_anomaly(timeout_s=0.2)
+        elapsed = time.monotonic() - t0
+        assert got is None
+        assert elapsed >= 0.15, f"returned in {elapsed:.3f}s — busy spin"
+
+    def test_enqueue_wakes_delayed_wait(self):
+        from cruise_control_tpu.detector import AnomalyDetectorManager, NoopNotifier
+        from cruise_control_tpu.detector.anomalies import Anomaly, AnomalyType
+
+        class _A(Anomaly):
+            anomaly_type = AnomalyType.GOAL_VIOLATION
+
+            def description(self):
+                return "test"
+
+            def fix_with(self, cc):
+                return None
+
+        mgr = AnomalyDetectorManager(None, NoopNotifier(), detectors=[])
+        blocked = _A()
+        mgr._enqueue(blocked)
+        mgr._checked[blocked.anomaly_id] = int(time.time() * 1000) + 60_000
+        fresh = _A()
+        result = {}
+
+        def taker():
+            result["got"] = mgr._next_anomaly(timeout_s=5.0)
+
+        t = threading.Thread(target=taker)
+        t.start()
+        time.sleep(0.05)
+        mgr._enqueue(fresh)
+        t.join(timeout=2.0)
+        assert not t.is_alive()
