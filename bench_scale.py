@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Scale benchmarks beyond the flagship bench.py config.
+
+Runs BASELINE.md config #3 (1k brokers / 100k partitions, add/remove-broker style
+skew, RackAware + ReplicaCapacity + capacity goals) and prints one JSON line per
+config.  Not wired into the driver's bench.py contract — used to track the
+scale-out solver milestones (SURVEY §7 step 5).
+
+Usage: python bench_scale.py [--cpu] [--full-goals]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    ap.add_argument("--full-goals", action="store_true", help="run all 16 goals")
+    ap.add_argument("--brokers", type=int, default=1000)
+    ap.add_argument("--partitions", type=int, default=100_000)
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
+    from cruise_control_tpu.analyzer import goals_base as G
+    from cruise_control_tpu.synthetic import SyntheticSpec, generate
+
+    spec = SyntheticSpec(
+        num_racks=20,
+        num_brokers=args.brokers,
+        num_topics=1000,
+        num_partitions=args.partitions,
+        replication_factor=3,
+        distribution="exponential",
+        skew_brokers=args.brokers // 4,
+        mean_cpu=0.25,
+        mean_disk=0.2,
+        mean_nw_in=0.15,
+        mean_nw_out=0.15,
+        seed=11,
+    )
+    state, maps = generate(spec)
+    ctx = GoalContext.build(state.num_topics, state.num_brokers)
+    goal_ids = (
+        G.DEFAULT_GOAL_ORDER
+        if args.full_goals
+        else (
+            G.RACK_AWARE,
+            G.REPLICA_CAPACITY,
+            G.DISK_CAPACITY,
+            G.NW_IN_CAPACITY,
+            G.NW_OUT_CAPACITY,
+            G.CPU_CAPACITY,
+        )
+    )
+    opt = GoalOptimizer(goal_ids=goal_ids, enable_heavy_goals=args.full_goals)
+    opt.optimize(state, ctx)                      # compile warm-up
+    t0 = time.monotonic()
+    final, result = opt.optimize(state, ctx)
+    wall = time.monotonic() - t0
+    residual_hard = sum(
+        result.violations_after[name] for name in result.violated_hard_goals
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"rebalance_wall_s_{args.brokers}brokers_{args.partitions}partitions",
+                "value": round(wall, 3),
+                "unit": "s",
+                "residual_hard_violations": residual_hard,
+                "total_moves": result.total_moves,
+                "goals": len(goal_ids),
+                "provision": result.provision.status,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

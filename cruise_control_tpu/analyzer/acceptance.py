@@ -2,16 +2,23 @@
 
 Counterpart of ``Goal.actionAcceptance`` (``analyzer/goals/Goal.java:81``) and the
 ``maybeApplyBalancingAction`` veto loop (``AbstractGoal.java:230``): an action is only
-applied if *every previously optimized goal* accepts it.  Here acceptance is evaluated
-for a whole :class:`MoveBatch` at once, and the set of enforcing goals arrives as a
-**traced** ``prior_mask`` bool[NUM_GOALS] — so one compiled round step serves every
-position in any goal priority list.
+applied if *every previously optimized goal* accepts it.  Acceptance appears in three
+forms, all reading the pre-round :class:`Snapshot`:
+
+* per-slot kernels over a :class:`MoveBatch` (``accept_all``) — the final gate; the
+  optimizer also re-runs them with score-ordered *cumulative* deltas so many actions
+  per broker can be admitted per round (see ``moves.cumulative_effects``);
+* a factorized ``bool[S, B]`` destination-eligibility matrix for replica moves
+  (``move_dst_matrix``) — the proposers consult it *before* choosing a destination,
+  which is the batched analogue of the reference's candidate walk trying the next
+  destination when one is vetoed (AbstractGoal.java:230-267).  Without it a
+  deterministic proposer can livelock re-proposing a vetoed destination;
+* a ``bool[R]`` leadership-target mask (``leadership_target_ok``) playing the same
+  role for leadership transfers.
 
 Each kernel encodes the reference goal's documented rule, e.g. for distribution goals
 (ResourceDistributionGoal.java:100-160): "never make a balanced broker unbalanced;
-otherwise never increase the utilization difference".  All kernels read the
-pre-round :class:`Snapshot` — valid because conflict resolution admits at most one
-action per destination broker and per partition per round.
+otherwise never increase the utilization difference".
 """
 
 from __future__ import annotations
@@ -83,24 +90,9 @@ def accept_capacity(state, ctx, snap, moves, eff, res: int):
 def accept_potential_nw_out(state, ctx, snap, moves, eff):
     """PotentialNwOutGoal (:42): destination's potential outbound (every replica
     promoted) stays within the NW_OUT capacity threshold."""
-    p = eff.partition
-    leader_nw = (
-        state.base_load[jnp.maximum(moves.replica, 0), Resource.NW_OUT]
-        + state.leadership_delta[p, Resource.NW_OUT]
-    )
-    kind = moves.kind
-    partner = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
-    partner_nw = (
-        state.base_load[partner, Resource.NW_OUT]
-        + state.leadership_delta[state.replica_partition[partner], Resource.NW_OUT]
-    )
-    delta = jnp.where(
-        kind == KIND_REPLICA_MOVE, leader_nw,
-        jnp.where(kind == KIND_SWAP, leader_nw - partner_nw, 0.0),
-    )
     limit = snap.cap_limits[:, Resource.NW_OUT]
-    after = snap.potential_nw_out[eff.dst_broker] + delta
-    return (after <= limit[eff.dst_broker]) | (delta <= 0.0)
+    after = snap.potential_nw_out[eff.dst_broker] + eff.pnw_delta_dst
+    return (after <= limit[eff.dst_broker]) | (eff.pnw_delta_dst <= 0.0)
 
 
 def accept_replica_count_dist(state, ctx, snap, moves, eff):
@@ -161,12 +153,9 @@ def accept_topic_replica_dist(state, ctx, snap, moves, eff):
 def accept_leader_bytes_in(state, ctx, snap, moves, eff):
     """LeaderBytesInDistributionGoal (:50): destination leader-bytes-in stays under
     the upper band or under the source's pre-move value."""
-    nw_in = snap.eff_load[jnp.maximum(moves.replica, 0), Resource.NW_IN]
-    gains = eff.leader_delta_dst > 0
-    delta = jnp.where(gains, nw_in, 0.0)
-    after = snap.leader_nw_in[eff.dst_broker] + delta
+    after = snap.leader_nw_in[eff.dst_broker] + eff.lbi_delta_dst
     return (
-        (~gains)
+        (eff.lbi_delta_dst <= 0.0)
         | (after <= snap.leader_nw_in_upper)
         | (after <= snap.leader_nw_in[eff.src_broker])
     )
@@ -209,3 +198,317 @@ def accept_all(
             prior_mask[gid], accept_resource_dist(state, ctx, snap, moves, eff, res), True
         )
     return ok
+
+
+# ---------------------------------------------------------------------------
+# Factorized destination eligibility (per-(slot, destination-broker) matrices).
+# ---------------------------------------------------------------------------
+
+
+def move_dst_matrix(
+    state: ClusterArrays,
+    ctx: GoalContext,
+    snap: Snapshot,
+    cand: jax.Array,        # i32[S] candidate replica per slot (clamped to valid idx)
+    cand_valid: jax.Array,  # bool[S]
+    prior_mask: jax.Array,  # bool[NUM_GOALS]
+) -> jax.Array:
+    """bool[S, B]: would every prior goal accept moving ``cand[s]`` to broker b?
+
+    The per-slot acceptance kernels above all factor into (slot attrs, destination
+    attrs), so each prior goal contributes one broadcast comparison.  Proposers AND
+    this into destination eligibility, guaranteeing a proposed move is pre-accepted
+    — the vectorized form of the reference's "try the next candidate destination"
+    walk.  Slots are replica moves only (swap eligibility stays per-slot).
+    """
+    S = cand.shape[0]
+    B = state.num_brokers
+    r = jnp.where(cand_valid, cand, 0)
+    p = state.replica_partition[r]
+    topic = state.partition_topic[p]
+    src = state.replica_broker[r]
+    eff = snap.eff_load[r]                      # f32[S, 4]
+    leads = snap.is_leader[r]
+
+    ok = jnp.ones((S, B), bool)
+
+    # RackAwareGoal
+    dst_rack = state.broker_rack[None, :]       # [1, B]
+    src_rack = state.broker_rack[src][:, None]  # [S, 1]
+    occ = snap.rack_counts[p][:, state.broker_rack] - (src_rack == dst_rack).astype(jnp.int32)
+    ok &= jnp.where(prior_mask[G.RACK_AWARE], occ == 0, True)
+
+    # MinTopicLeadersPerBrokerGoal — source-side only (leader leaving a broker)
+    if snap.enable_heavy:
+        protected = ctx.min_leader_topics[topic]
+        after_src = snap.topic_leader_counts[src, topic] - leads.astype(jnp.int32)
+        mtl_ok = ~(protected & leads) | (after_src >= ctx.constraint.min_topic_leaders_per_broker)
+        ok &= jnp.where(prior_mask[G.MIN_TOPIC_LEADERS], mtl_ok[:, None], True)
+
+    # ReplicaCapacityGoal
+    counts = snap.replica_counts
+    ok &= jnp.where(
+        prior_mask[G.REPLICA_CAPACITY],
+        (counts[None, :] + 1 <= ctx.constraint.max_replicas_per_broker),
+        True,
+    )
+
+    # Capacity goals
+    for gid, res in G.CAPACITY_RESOURCE.items():
+        fits = snap.broker_load[None, :, res] + eff[:, None, res] <= snap.cap_limits[None, :, res]
+        ok &= jnp.where(prior_mask[gid], fits, True)
+
+    # ReplicaDistributionGoal
+    upper = snap.replica_band[1]
+    dst_after = counts[None, :] + 1
+    rd_ok = (dst_after <= upper) | (dst_after <= counts[src][:, None] - 1)
+    ok &= jnp.where(prior_mask[G.REPLICA_DISTRIBUTION], rd_ok, True)
+
+    # PotentialNwOutGoal
+    leader_nw = (
+        state.base_load[r, Resource.NW_OUT]
+        + state.leadership_delta[p, Resource.NW_OUT]
+    )
+    pnw_after = snap.potential_nw_out[None, :] + leader_nw[:, None]
+    pnw_ok = pnw_after <= snap.cap_limits[None, :, Resource.NW_OUT]
+    ok &= jnp.where(prior_mask[G.POTENTIAL_NW_OUT], pnw_ok, True)
+
+    # ResourceDistributionGoals
+    for gid, res in G.DIST_RESOURCE.items():
+        low = snap.low_util[res]
+        cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+        src_before = snap.broker_load[src, res]
+        dst_before = snap.broker_load[:, res][None, :]
+        src_after = src_before - eff[:, res]
+        dst_after_l = dst_before + eff[:, None, res]
+        within_before = (src_before >= snap.res_lower[src, res])[:, None] & (
+            dst_before <= snap.res_upper[None, :, res]
+        )
+        ok_within = (dst_after_l <= snap.res_upper[None, :, res]) & (
+            src_after >= snap.res_lower[src, res]
+        )[:, None]
+        ok_fb = dst_after_l / cap[None, :] <= (src_before / cap[src])[:, None]
+        no_load = (eff[:, res] <= 0.0)[:, None]
+        dist_ok = low | no_load | jnp.where(within_before, ok_within, ok_fb)
+        ok &= jnp.where(prior_mask[gid], dist_ok, True)
+
+    # TopicReplicaDistributionGoal
+    if snap.enable_heavy:
+        bt = snap.topic_counts
+        tup = snap.topic_band[1]
+        dst_t_after = bt[:, topic].T + 1                      # [S, B]
+        td_ok = (dst_t_after <= tup[topic][:, None]) | (
+            dst_t_after <= bt[src, topic][:, None] - 1
+        )
+        ok &= jnp.where(prior_mask[G.TOPIC_REPLICA_DIST], td_ok, True)
+
+    # LeaderReplicaDistributionGoal (only when the moved replica leads)
+    lupper = snap.leader_band[1]
+    l_after = snap.leader_counts[None, :] + 1
+    ld_ok = (~leads)[:, None] | (l_after <= lupper) | (
+        l_after <= snap.leader_counts[src][:, None] - 1
+    )
+    ok &= jnp.where(prior_mask[G.LEADER_REPLICA_DIST], ld_ok, True)
+
+    # LeaderBytesInDistributionGoal (only when the moved replica leads)
+    nw_in = eff[:, Resource.NW_IN]
+    lbi_after = snap.leader_nw_in[None, :] + jnp.where(leads, nw_in, 0.0)[:, None]
+    lbi_ok = (~leads)[:, None] | (lbi_after <= snap.leader_nw_in_upper) | (
+        lbi_after <= snap.leader_nw_in[src][:, None]
+    )
+    ok &= jnp.where(prior_mask[G.LEADER_BYTES_IN_DIST], lbi_ok, True)
+
+    return ok & cand_valid[:, None]
+
+
+def leadership_target_ok(
+    state: ClusterArrays,
+    ctx: GoalContext,
+    snap: Snapshot,
+    prior_mask: jax.Array,
+) -> jax.Array:
+    """bool[R]: would every prior goal accept transferring its partition's
+    leadership TO this replica?
+
+    The destination broker is the replica's own broker, so this is a per-replica
+    mask rather than a matrix.  Source-side checks (the current leader losing
+    leadership) use the partition's current leader broker.
+    """
+    R = state.num_replicas
+    p = state.replica_partition
+    topic = state.partition_topic[p]
+    b = state.replica_broker
+    cur_leader = state.partition_leader[p]
+    leader_b = state.replica_broker[jnp.maximum(cur_leader, 0)]
+    ldelta = state.leadership_delta[p]          # f32[R, 4]
+
+    ok = jnp.ones(R, bool)
+
+    # MinTopicLeaders: the current leader's broker must keep its minimum
+    if snap.enable_heavy:
+        protected = ctx.min_leader_topics[topic]
+        after_src = snap.topic_leader_counts[leader_b, topic] - 1
+        mtl_ok = ~protected | (after_src >= ctx.constraint.min_topic_leaders_per_broker)
+        ok &= jnp.where(prior_mask[G.MIN_TOPIC_LEADERS], mtl_ok, True)
+
+    # Capacity goals: the gaining broker absorbs the leadership delta
+    for gid, res in G.CAPACITY_RESOURCE.items():
+        fits = snap.broker_load[b, res] + ldelta[:, res] <= snap.cap_limits[b, res]
+        ok &= jnp.where(prior_mask[gid], fits | (ldelta[:, res] <= 0.0), True)
+
+    # ResourceDistributionGoals
+    for gid, res in G.DIST_RESOURCE.items():
+        low = snap.low_util[res]
+        cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+        src_before = snap.broker_load[leader_b, res]
+        dst_before = snap.broker_load[b, res]
+        src_after = src_before - ldelta[:, res]
+        dst_after = dst_before + ldelta[:, res]
+        within_before = (src_before >= snap.res_lower[leader_b, res]) & (
+            dst_before <= snap.res_upper[b, res]
+        )
+        ok_within = (dst_after <= snap.res_upper[b, res]) & (
+            src_after >= snap.res_lower[leader_b, res]
+        )
+        ok_fb = dst_after / cap[b] <= src_before / cap[leader_b]
+        dist_ok = low | (ldelta[:, res] <= 0.0) | jnp.where(within_before, ok_within, ok_fb)
+        ok &= jnp.where(prior_mask[gid], dist_ok, True)
+
+    # LeaderReplicaDistributionGoal
+    l_after = snap.leader_counts[b] + 1
+    ld_ok = (l_after <= snap.leader_band[1]) | (l_after <= snap.leader_counts[leader_b] - 1)
+    ok &= jnp.where(prior_mask[G.LEADER_REPLICA_DIST], ld_ok, True)
+
+    # LeaderBytesInDistributionGoal
+    nw_in = snap.eff_load[:, Resource.NW_IN]
+    lbi_after = snap.leader_nw_in[b] + nw_in
+    lbi_ok = (lbi_after <= snap.leader_nw_in_upper) | (lbi_after <= snap.leader_nw_in[leader_b])
+    ok &= jnp.where(prior_mask[G.LEADER_BYTES_IN_DIST], lbi_ok, True)
+
+    return ok & state.replica_valid & (cur_leader >= 0)
+
+
+def swap_dst_matrix(
+    state: ClusterArrays,
+    ctx: GoalContext,
+    snap: Snapshot,
+    cand: jax.Array,           # i32[S] outgoing replica per slot (clamped)
+    cand_valid: jax.Array,     # bool[S]
+    partner: jax.Array,        # i32[B] incoming partner replica per dst (clamped)
+    partner_valid: jax.Array,  # bool[B]
+    prior_mask: jax.Array,
+) -> jax.Array:
+    """bool[S, B]: would every prior goal accept swapping ``cand[s]`` with
+    broker b's ``partner[b]``?
+
+    Unlike two bare-move checks, all threshold goals see the swap's **net**
+    deltas — replica counts never change, and load checks use e_out − e_in —
+    so a swap remains proposable exactly where the reference's
+    ``rebalanceBySwappingLoadOut`` walk would find it
+    (ResourceDistributionGoal.java:599): when plain moves are vetoed.
+    Per-topic swap count deltas are ignored (matching the per-slot kernel,
+    which treats swaps as count-neutral).
+    """
+    S = cand.shape[0]
+    B = state.num_brokers
+    r = jnp.where(cand_valid, cand, 0)
+    q = jnp.where(partner_valid, partner, 0)
+    p_out = state.replica_partition[r]
+    p_in = state.replica_partition[q]
+    src = state.replica_broker[r]
+    e_out = snap.eff_load[r]           # [S, 4]
+    e_in = snap.eff_load[q]            # [B, 4]
+    leads_out = snap.is_leader[r]      # [S]
+    leads_in = snap.is_leader[q]       # [B]
+    t_out = state.partition_topic[p_out]
+    t_in = state.partition_topic[p_in]
+
+    ok = jnp.ones((S, B), bool)
+
+    # RackAwareGoal — both directions, exact (distinct partitions)
+    dst_rack = state.broker_rack[None, :]
+    src_rack = state.broker_rack[src][:, None]
+    occ_fwd = snap.rack_counts[p_out][:, state.broker_rack] - (src_rack == dst_rack).astype(jnp.int32)
+    # occ_bwd[s, d] = replicas of partner[d]'s partition in slot s's source rack
+    occ_bwd = (
+        snap.rack_counts[p_in][:, state.broker_rack[src]].T
+        - (dst_rack == src_rack).astype(jnp.int32)
+    )
+    ok &= jnp.where(prior_mask[G.RACK_AWARE], (occ_fwd == 0) & (occ_bwd == 0), True)
+
+    # MinTopicLeaders — each side losing a protected leader must keep its minimum
+    if snap.enable_heavy:
+        min_l = ctx.constraint.min_topic_leaders_per_broker
+        prot_out = ctx.min_leader_topics[t_out]
+        src_ok = ~(prot_out & leads_out) | (
+            snap.topic_leader_counts[src, t_out] - 1 >= min_l
+        )
+        prot_in = ctx.min_leader_topics[t_in]
+        dst_ok = ~(prot_in & leads_in) | (
+            snap.topic_leader_counts[jnp.arange(B), t_in] - 1 >= min_l
+        )
+        ok &= jnp.where(
+            prior_mask[G.MIN_TOPIC_LEADERS], src_ok[:, None] & dst_ok[None, :], True
+        )
+
+    # Replica counts never change in a swap: ReplicaCapacityGoal,
+    # ReplicaDistributionGoal, TopicReplicaDistributionGoal accept by construction.
+
+    # Capacity goals — net load at the destination (source only sheds when gain>0,
+    # which the proposer's gain_fn enforces per goal)
+    for gid, res in G.CAPACITY_RESOURCE.items():
+        net = e_out[:, None, res] - e_in[None, :, res]
+        after = snap.broker_load[None, :, res] + net
+        fits = (after <= snap.cap_limits[None, :, res]) | (net <= 0.0)
+        ok &= jnp.where(prior_mask[gid], fits, True)
+
+    # ResourceDistributionGoals — net deltas at both endpoints
+    for gid, res in G.DIST_RESOURCE.items():
+        low = snap.low_util[res]
+        cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
+        net = e_out[:, None, res] - e_in[None, :, res]      # dst gains this
+        src_before = snap.broker_load[src, res][:, None]
+        dst_before = snap.broker_load[:, res][None, :]
+        src_after = src_before - net
+        dst_after = dst_before + net
+        within_before = (src_before >= snap.res_lower[src, res][:, None]) & (
+            dst_before <= snap.res_upper[None, :, res]
+        )
+        ok_within = (dst_after <= snap.res_upper[None, :, res]) & (
+            src_after >= snap.res_lower[src, res][:, None]
+        )
+        ok_fb = dst_after / cap[None, :] <= src_before / cap[src][:, None]
+        dist_ok = low | (net <= 0.0) | jnp.where(within_before, ok_within, ok_fb)
+        ok &= jnp.where(prior_mask[gid], dist_ok, True)
+
+    # PotentialNwOutGoal — net potential outbound at the destination
+    lnw_out = (
+        state.base_load[r, Resource.NW_OUT] + state.leadership_delta[p_out, Resource.NW_OUT]
+    )
+    lnw_in = (
+        state.base_load[q, Resource.NW_OUT] + state.leadership_delta[p_in, Resource.NW_OUT]
+    )
+    pnw_net = lnw_out[:, None] - lnw_in[None, :]
+    pnw_after = snap.potential_nw_out[None, :] + pnw_net
+    pnw_ok = (pnw_after <= snap.cap_limits[None, :, Resource.NW_OUT]) | (pnw_net <= 0.0)
+    ok &= jnp.where(prior_mask[G.POTENTIAL_NW_OUT], pnw_ok, True)
+
+    # LeaderReplicaDistributionGoal — net leader-count delta at the destination
+    net_lead = leads_out.astype(jnp.int32)[:, None] - leads_in.astype(jnp.int32)[None, :]
+    l_after = snap.leader_counts[None, :] + net_lead
+    ld_ok = (net_lead <= 0) | (l_after <= snap.leader_band[1]) | (
+        l_after <= snap.leader_counts[src][:, None] - 1
+    )
+    ok &= jnp.where(prior_mask[G.LEADER_REPLICA_DIST], ld_ok, True)
+
+    # LeaderBytesInDistributionGoal — net leader bytes-in at the destination
+    lbi_out = jnp.where(leads_out, e_out[:, Resource.NW_IN], 0.0)
+    lbi_in = jnp.where(leads_in, e_in[:, Resource.NW_IN], 0.0)
+    lbi_net = lbi_out[:, None] - lbi_in[None, :]
+    lbi_after = snap.leader_nw_in[None, :] + lbi_net
+    lbi_ok = (lbi_net <= 0.0) | (lbi_after <= snap.leader_nw_in_upper) | (
+        lbi_after <= snap.leader_nw_in[src][:, None]
+    )
+    ok &= jnp.where(prior_mask[G.LEADER_BYTES_IN_DIST], lbi_ok, True)
+
+    return ok & cand_valid[:, None] & partner_valid[None, :]

@@ -42,6 +42,11 @@ class GoalContext:
     #: (``topics.with.min.leaders.per.broker``); all-False disables the goal.
     min_leader_topics: jax.Array
     fast_mode: jax.Array                   # bool scalar
+    #: candidate actions nominated per broker per round (static: shapes depend on
+    #: it).  Larger values admit more moves per round at more memory per round —
+    #: the depth of the reference's per-broker SortedReplicas candidate walk that
+    #: runs *in parallel* here.
+    top_k: int = struct.field(pytree_node=False, default=8)
 
     @classmethod
     def build(
@@ -56,6 +61,7 @@ class GoalContext:
         triggered_by_violation: bool = False,
         min_leader_topic_ids: Sequence[int] = (),
         fast_mode: bool = False,
+        top_k: int = 8,
     ) -> "GoalContext":
         et = jnp.zeros(num_topics, bool)
         if excluded_topic_ids:
@@ -78,6 +84,7 @@ class GoalContext:
             triggered_by_violation=jnp.asarray(triggered_by_violation),
             min_leader_topics=ml,
             fast_mode=jnp.asarray(fast_mode),
+            top_k=top_k,
         )
 
 

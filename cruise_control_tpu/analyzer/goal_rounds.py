@@ -1,12 +1,15 @@
 """Per-goal round functions: each goal's ``rebalanceForBroker`` as batched kernels.
 
 Every entry in :data:`GOAL_ROUNDS` maps a goal id to an ordered tuple of round
-functions ``(state, ctx, snap) -> MoveBatch``.  The optimizer drives each round type
-to convergence in order (e.g. leadership transfers before replica moves, matching
-ResourceDistributionGoal.java:380's phasing), then moves to the next goal.
+functions ``(state, ctx, snap, prior_mask, salt) -> MoveBatch``.  The optimizer
+drives each round type to convergence in order (e.g. leadership transfers before
+replica moves, matching ResourceDistributionGoal.java:380's phasing), then moves to
+the next goal.  ``prior_mask`` feeds the proposers' prior-goal-aware destination
+choice; ``salt`` (the round number) rotates tie-breaking so deterministic collisions
+can't recur.
 
 Round functions only *propose improving actions for this goal*; the optimizer layers
-prior-goal acceptance and conflict resolution on top.  All band/limit tensors come
+final acceptance and cumulative admission on top.  All band/limit tensors come
 precomputed from the :class:`Snapshot`.
 """
 
@@ -25,11 +28,12 @@ from cruise_control_tpu.analyzer.proposers import (
     leadership_fill_round,
     leadership_shed_round,
     shed_round,
+    swap_round,
 )
 from cruise_control_tpu.core.resources import Resource
 from cruise_control_tpu.model.arrays import ClusterArrays
 
-RoundFn = Callable[[ClusterArrays, GoalContext, Snapshot], MoveBatch]
+RoundFn = Callable[[ClusterArrays, GoalContext, Snapshot, jax.Array, jax.Array], MoveBatch]
 
 NEG = jnp.float32(-3e38)
 
@@ -46,7 +50,10 @@ def _bcast(row: jax.Array, n: int) -> jax.Array:
 # -- offline repair (pre-phase) ----------------------------------------------------
 
 
-def offline_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def offline_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     """Move replicas off dead brokers/disks — the array analogue of the requirement
     that every goal first relocates offline replicas (self-healing semantics of
     AbstractGoal's dead-broker handling).  Destinations must be rack-safe and under
@@ -69,7 +76,7 @@ def offline_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> Mov
         return rack_ok & fits & count_ok, score
 
     return shed_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         src_need=offline_per_broker,
         cand_score=jnp.zeros(state.num_replicas, jnp.float32),
         cand_ok=snap.offline,
@@ -77,7 +84,10 @@ def offline_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> Mov
     )
 
 
-def offline_round_relaxed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def offline_round_relaxed(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     """Fallback offline repair without rack/capacity preconditions — ensures no
     replica is stranded on a dead broker even in tight clusters (the goals then
     re-balance); only destination aliveness and partition-uniqueness are required."""
@@ -87,18 +97,12 @@ def offline_round_relaxed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot
     )
 
     def dst_fn(cand: jax.Array):
-        p = state.replica_partition[cand]
-        on_dst = jax.ops.segment_sum(
-            state.replica_valid.astype(jnp.int32),
-            state.replica_partition * state.num_brokers + state.replica_broker,
-            num_segments=state.num_partitions * state.num_brokers,
-        ).reshape(state.num_partitions, state.num_brokers)
-        dup = on_dst[p] > 0  # [S, B]
         score = _bcast(-snap.util_pct.max(axis=-1), cand.shape[0])
-        return ~dup, score
+        elig = jnp.ones((cand.shape[0], state.num_brokers), bool)
+        return elig, score
 
     return shed_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         src_need=offline_per_broker,
         cand_score=jnp.zeros(state.num_replicas, jnp.float32),
         cand_ok=snap.offline,
@@ -109,7 +113,10 @@ def offline_round_relaxed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot
 # -- RackAwareGoal (RackAwareGoal.java:35, rebalance :152) -------------------------
 
 
-def rack_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def rack_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     viol = G.rack_violating_replicas(state, snap)
     src_need = jax.ops.segment_sum(
         viol.astype(jnp.float32), state.replica_broker, num_segments=state.num_brokers
@@ -124,7 +131,7 @@ def rack_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBa
         return occ == 0, score
 
     return shed_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         src_need=src_need,
         cand_score=jnp.zeros(state.num_replicas, jnp.float32),
         cand_ok=viol & (snap.movable | snap.offline),
@@ -135,7 +142,10 @@ def rack_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBa
 # -- ReplicaCapacityGoal -----------------------------------------------------------
 
 
-def replica_capacity_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def replica_capacity_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     max_r = ctx.constraint.max_replicas_per_broker
     src_need = (snap.replica_counts - max_r).astype(jnp.float32)
 
@@ -145,7 +155,7 @@ def replica_capacity_round(state: ClusterArrays, ctx: GoalContext, snap: Snapsho
         return ok, score
 
     return shed_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         src_need=src_need,
         cand_score=-snap.eff_load[:, Resource.DISK],  # cheapest moves first
         cand_ok=snap.movable,
@@ -157,14 +167,14 @@ def replica_capacity_round(state: ClusterArrays, ctx: GoalContext, snap: Snapsho
 
 
 def _capacity_leadership_round(res: int) -> RoundFn:
-    def fn(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    def fn(state, ctx, snap, prior_mask, salt):
         limit = snap.cap_limits[:, res]
         src_need = snap.broker_load[:, res] - limit
         ldelta = state.leadership_delta[state.replica_partition, res]
         fb = state.replica_broker
         fits = snap.broker_load[fb, res] + ldelta <= limit[fb]
         return leadership_shed_round(
-            state, snap,
+            state, ctx, snap, prior_mask, salt,
             src_need=src_need,
             leader_score=ldelta,
             leader_ok=snap.movable,
@@ -176,7 +186,7 @@ def _capacity_leadership_round(res: int) -> RoundFn:
 
 
 def _capacity_move_round(res: int) -> RoundFn:
-    def fn(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    def fn(state, ctx, snap, prior_mask, salt):
         limit = snap.cap_limits[:, res]
         src_need = snap.broker_load[:, res] - limit
         headroom = jnp.where(snap.dest_ok, limit - snap.broker_load[:, res], NEG)
@@ -190,7 +200,7 @@ def _capacity_move_round(res: int) -> RoundFn:
             return fits, score
 
         return shed_round(
-            state, snap,
+            state, ctx, snap, prior_mask, salt,
             src_need=src_need,
             cand_score=load,
             cand_ok=snap.movable & (load <= max_headroom) & (load > 0),
@@ -203,7 +213,10 @@ def _capacity_move_round(res: int) -> RoundFn:
 # -- ReplicaDistributionGoal (:51) -------------------------------------------------
 
 
-def replica_dist_shed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def replica_dist_shed(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     lo, up = snap.replica_band[0], snap.replica_band[1]
     src_need = (snap.replica_counts - up).astype(jnp.float32)
 
@@ -213,7 +226,7 @@ def replica_dist_shed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) ->
         return ok, score
 
     return shed_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         src_need=src_need,
         cand_score=-snap.eff_load[:, Resource.DISK],
         cand_ok=snap.movable,
@@ -221,7 +234,10 @@ def replica_dist_shed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) ->
     )
 
 
-def replica_dist_fill(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def replica_dist_fill(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     lo, up = snap.replica_band[0], snap.replica_band[1]
     dst_need = (lo - snap.replica_counts).astype(jnp.float32)
     donor_keeps = snap.replica_counts[state.replica_broker] - 1 >= lo
@@ -233,7 +249,7 @@ def replica_dist_fill(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) ->
         return improves, src_score
 
     return fill_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         dst_need=dst_need,
         donor_score=-snap.eff_load[:, Resource.DISK],
         donor_ok=snap.movable & donor_keeps,
@@ -244,7 +260,10 @@ def replica_dist_fill(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) ->
 # -- PotentialNwOutGoal (:42) ------------------------------------------------------
 
 
-def potential_nw_out_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def potential_nw_out_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     limit = snap.cap_limits[:, Resource.NW_OUT]
     src_need = snap.potential_nw_out - limit
     leader_nw = (
@@ -262,7 +281,7 @@ def potential_nw_out_round(state: ClusterArrays, ctx: GoalContext, snap: Snapsho
         return fits, score
 
     return shed_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         src_need=src_need,
         cand_score=leader_nw,
         cand_ok=snap.movable & (leader_nw <= max_headroom),
@@ -274,7 +293,7 @@ def potential_nw_out_round(state: ClusterArrays, ctx: GoalContext, snap: Snapsho
 
 
 def _dist_leadership_round(res: int) -> RoundFn:
-    def fn(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    def fn(state, ctx, snap, prior_mask, salt):
         upper = snap.res_upper[:, res]
         low = snap.low_util[res]
         src_need = jnp.where(low, 0.0, snap.broker_load[:, res] - upper)
@@ -282,7 +301,7 @@ def _dist_leadership_round(res: int) -> RoundFn:
         fb = state.replica_broker
         fits = snap.broker_load[fb, res] + ldelta <= upper[fb]
         return leadership_shed_round(
-            state, snap,
+            state, ctx, snap, prior_mask, salt,
             src_need=src_need,
             leader_score=ldelta,
             leader_ok=snap.movable,
@@ -294,7 +313,7 @@ def _dist_leadership_round(res: int) -> RoundFn:
 
 
 def _dist_shed_round(res: int) -> RoundFn:
-    def fn(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    def fn(state, ctx, snap, prior_mask, salt):
         lower, upper = snap.res_lower[:, res], snap.res_upper[:, res]
         low = snap.low_util[res]
         src_need = jnp.where(low, 0.0, snap.broker_load[:, res] - upper)
@@ -311,7 +330,7 @@ def _dist_shed_round(res: int) -> RoundFn:
             return fits, score
 
         return shed_round(
-            state, snap,
+            state, ctx, snap, prior_mask, salt,
             src_need=src_need,
             cand_score=load,
             cand_ok=snap.movable & keeps_src & (load > 0) & (load <= max_headroom),
@@ -322,7 +341,7 @@ def _dist_shed_round(res: int) -> RoundFn:
 
 
 def _dist_fill_round(res: int) -> RoundFn:
-    def fn(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+    def fn(state, ctx, snap, prior_mask, salt):
         lower, upper = snap.res_lower[:, res], snap.res_upper[:, res]
         low = snap.low_util[res]
         dst_need = jnp.where(low, 0.0, lower - snap.broker_load[:, res])
@@ -336,7 +355,7 @@ def _dist_fill_round(res: int) -> RoundFn:
             return fits, src_score
 
         return fill_round(
-            state, snap,
+            state, ctx, snap, prior_mask, salt,
             dst_need=dst_need,
             donor_score=load,
             donor_ok=snap.movable & donor_keeps & (load > 0),
@@ -346,10 +365,79 @@ def _dist_fill_round(res: int) -> RoundFn:
     return fn
 
 
+def _dist_swap_round(res: int) -> RoundFn:
+    """Pairwise swap fallback for usage-distribution goals
+    (ResourceDistributionGoal.rebalanceBySwappingLoadOut, :599): runs after the
+    move rounds converge; sheds net load from still-over-upper brokers by trading
+    a heavy replica for a light one, keeping replica counts intact."""
+
+    def fn(state, ctx, snap, prior_mask, salt):
+        upper = snap.res_upper[:, res]
+        low = snap.low_util[res]
+        src_need = jnp.where(low, 0.0, snap.broker_load[:, res] - upper)
+        load = snap.eff_load[:, res]
+
+        def gain_fn(r_out, partner):
+            e_out = load[r_out][:, None]
+            e_in = load[partner][None, :]
+            gain = e_out - e_in                       # net load shed from the source
+            dst_after = snap.broker_load[None, :, res] + gain
+            ok = (gain > 0.0) & (dst_after <= upper[None, :])
+            return ok, gain
+
+        return swap_round(
+            state, ctx, snap, prior_mask, salt,
+            src_need=src_need,
+            out_score=load,
+            out_ok=snap.movable & (load > 0),
+            in_score=-load,
+            in_ok=snap.movable,
+            gain_fn=gain_fn,
+        )
+
+    return fn
+
+
+def _capacity_swap_round(res: int) -> RoundFn:
+    """Pairwise swap fallback for capacity goals: when no destination can absorb a
+    whole replica (rack-constrained destinations full — common in tight clusters),
+    trade a heavy replica for a light one.  The reference's CapacityGoal only
+    moves; the swap fallback is a TPU-side extension reusing the
+    ResourceDistributionGoal swap semantics against the capacity limit."""
+
+    def fn(state, ctx, snap, prior_mask, salt):
+        limit = snap.cap_limits[:, res]
+        src_need = snap.broker_load[:, res] - limit
+        load = snap.eff_load[:, res]
+
+        def gain_fn(r_out, partner):
+            e_out = load[r_out][:, None]
+            e_in = load[partner][None, :]
+            gain = e_out - e_in
+            dst_after = snap.broker_load[None, :, res] + gain
+            ok = (gain > 0.0) & (dst_after <= limit[None, :])
+            return ok, gain
+
+        return swap_round(
+            state, ctx, snap, prior_mask, salt,
+            src_need=src_need,
+            out_score=load,
+            out_ok=snap.movable & (load > 0),
+            in_score=-load,
+            in_ok=snap.movable,
+            gain_fn=gain_fn,
+        )
+
+    return fn
+
+
 # -- TopicReplicaDistributionGoal --------------------------------------------------
 
 
-def topic_dist_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def topic_dist_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     bt = snap.topic_counts
     tup = snap.topic_band[1]
     topic = state.partition_topic[state.replica_partition]
@@ -364,7 +452,7 @@ def topic_dist_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> 
         return ok, score
 
     return shed_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         src_need=src_need,
         cand_score=r_excess,
         cand_ok=snap.movable & (r_excess > 0),
@@ -375,13 +463,16 @@ def topic_dist_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> 
 # -- LeaderReplicaDistributionGoal -------------------------------------------------
 
 
-def leader_dist_shed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def leader_dist_shed(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     lup = snap.leader_band[1]
     src_need = (snap.leader_counts - lup).astype(jnp.float32)
     fb = state.replica_broker
     fits = snap.leader_counts[fb] + 1 <= lup
     return leadership_shed_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         src_need=src_need,
         leader_score=jnp.zeros(state.num_replicas, jnp.float32),
         leader_ok=snap.movable,
@@ -390,7 +481,10 @@ def leader_dist_shed(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> 
     )
 
 
-def leader_dist_fill(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def leader_dist_fill(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     llo = snap.leader_band[0]
     dst_need = (llo - snap.leader_counts).astype(jnp.float32)
     p = state.replica_partition
@@ -398,7 +492,7 @@ def leader_dist_fill(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> 
     leader_broker = state.replica_broker[jnp.maximum(cur_leader, 0)]
     donor_rich = snap.leader_counts[leader_broker] - 1 >= llo
     return leadership_fill_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         dst_need=dst_need,
         follower_score=snap.leader_counts[leader_broker].astype(jnp.float32),
         follower_ok=donor_rich & (cur_leader >= 0),
@@ -408,14 +502,17 @@ def leader_dist_fill(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> 
 # -- LeaderBytesInDistributionGoal (:50) -------------------------------------------
 
 
-def leader_bytes_in_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def leader_bytes_in_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     upper = snap.leader_nw_in_upper
     src_need = snap.leader_nw_in - upper
     nw_in = snap.eff_load[:, Resource.NW_IN]
     fb = state.replica_broker
     fits = snap.leader_nw_in[fb] + nw_in <= upper
     return leadership_shed_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         src_need=src_need,
         leader_score=nw_in,
         leader_ok=snap.movable,
@@ -427,7 +524,10 @@ def leader_bytes_in_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot
 # -- MinTopicLeadersPerBrokerGoal (:52) --------------------------------------------
 
 
-def min_topic_leaders_round(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> MoveBatch:
+def min_topic_leaders_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
     lead_bt = snap.topic_leader_counts
     need = ctx.constraint.min_topic_leaders_per_broker
     topic = state.partition_topic[state.replica_partition]
@@ -443,7 +543,7 @@ def min_topic_leaders_round(state: ClusterArrays, ctx: GoalContext, snap: Snapsh
     donor_spare = lead_bt[leader_broker, topic] - 1 >= need
     r_deficit = deficit[state.replica_broker, topic]
     return leadership_fill_round(
-        state, snap,
+        state, ctx, snap, prior_mask, salt,
         dst_need=dst_need,
         follower_score=r_deficit,
         follower_ok=protected & (r_deficit > 0) & donor_spare & (cur_leader >= 0),
@@ -456,35 +556,47 @@ GOAL_ROUNDS: Dict[int, Tuple[RoundFn, ...]] = {
     G.RACK_AWARE: (rack_round,),
     G.MIN_TOPIC_LEADERS: (min_topic_leaders_round,),
     G.REPLICA_CAPACITY: (replica_capacity_round,),
-    G.DISK_CAPACITY: (_capacity_move_round(Resource.DISK),),
-    G.NW_IN_CAPACITY: (_capacity_move_round(Resource.NW_IN),),
+    G.DISK_CAPACITY: (
+        _capacity_move_round(Resource.DISK),
+        _capacity_swap_round(Resource.DISK),
+    ),
+    G.NW_IN_CAPACITY: (
+        _capacity_move_round(Resource.NW_IN),
+        _capacity_swap_round(Resource.NW_IN),
+    ),
     G.NW_OUT_CAPACITY: (
         _capacity_leadership_round(Resource.NW_OUT),
         _capacity_move_round(Resource.NW_OUT),
+        _capacity_swap_round(Resource.NW_OUT),
     ),
     G.CPU_CAPACITY: (
         _capacity_leadership_round(Resource.CPU),
         _capacity_move_round(Resource.CPU),
+        _capacity_swap_round(Resource.CPU),
     ),
     G.REPLICA_DISTRIBUTION: (replica_dist_shed, replica_dist_fill),
     G.POTENTIAL_NW_OUT: (potential_nw_out_round,),
     G.DISK_USAGE_DIST: (
         _dist_shed_round(Resource.DISK),
         _dist_fill_round(Resource.DISK),
+        _dist_swap_round(Resource.DISK),
     ),
     G.NW_IN_USAGE_DIST: (
         _dist_shed_round(Resource.NW_IN),
         _dist_fill_round(Resource.NW_IN),
+        _dist_swap_round(Resource.NW_IN),
     ),
     G.NW_OUT_USAGE_DIST: (
         _dist_leadership_round(Resource.NW_OUT),
         _dist_shed_round(Resource.NW_OUT),
         _dist_fill_round(Resource.NW_OUT),
+        _dist_swap_round(Resource.NW_OUT),
     ),
     G.CPU_USAGE_DIST: (
         _dist_leadership_round(Resource.CPU),
         _dist_shed_round(Resource.CPU),
         _dist_fill_round(Resource.CPU),
+        _dist_swap_round(Resource.CPU),
     ),
     G.TOPIC_REPLICA_DIST: (topic_dist_round,),
     G.LEADER_REPLICA_DIST: (leader_dist_shed, leader_dist_fill),

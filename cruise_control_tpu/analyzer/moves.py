@@ -1,12 +1,30 @@
-"""Batched balancing actions.
+"""Batched balancing actions and high-throughput round admission.
 
 Counterpart of ``analyzer/BalancingAction.java`` / ``ActionType.java:23-28``, array-
-first: a :class:`MoveBatch` is a fixed-shape batch of K candidate actions (one slot per
-source broker in the round engine), where invalid slots carry ``replica == -1``.  The
-optimizer evaluates acceptance over the whole batch at once, resolves conflicts by
-deduplication (at most one action per destination broker and per partition per round —
-the parallel-greedy analogue of the reference's strictly sequential
-``maybeApplyBalancingAction``), and applies survivors as one scatter.
+first: a :class:`MoveBatch` is a fixed-shape batch of K candidate actions (several
+slots per source broker in the round engine), where invalid slots carry
+``replica == -1``.
+
+Admission (the parallel-greedy analogue of the reference's strictly sequential
+``maybeApplyBalancingAction``, AbstractGoal.java:230) admits **many actions per
+broker per round** while preserving every per-goal guarantee that the sequential
+walk provides:
+
+* at most one action per partition per round (rack-awareness / single-leader
+  invariants are per-partition, so they stay exactly checkable against the
+  pre-round snapshot);
+* per-broker threshold goals (capacity, counts, bands, potential outbound,
+  leader bytes-in) are checked against **score-ordered cumulative deltas**: slot
+  i's acceptance is evaluated as if every better-scored candidate touching the
+  same broker had already been applied.  Positive (load-gaining) deltas are
+  accumulated at destinations, negative (shedding) at sources, each with the
+  conservative positive/negative part, so the admitted set can never exceed a
+  budget any single admitted action was allowed to reach.  The top-scored slot
+  per broker sees exactly its own delta, so a round always admits at least as
+  much as a one-action-per-broker round would.
+
+Swaps exchange signed loads (their deltas are not monotone), so they keep the
+conservative one-action-per-broker rule instead of cumulative admission.
 """
 
 from __future__ import annotations
@@ -32,7 +50,7 @@ class MoveBatch:
     replica: jax.Array      # i32[K] source replica (for LEADERSHIP: current leader)
     dst_broker: jax.Array   # i32[K] destination broker
     dst_replica: jax.Array  # i32[K] swap partner / new leader replica; -1 otherwise
-    score: jax.Array        # f32[K] priority used for conflict dedup (higher wins)
+    score: jax.Array        # f32[K] admission priority (higher admits first)
 
     @property
     def num_slots(self) -> int:
@@ -55,16 +73,23 @@ class MoveBatch:
 
 @struct.dataclass
 class MoveEffects:
-    """Per-slot state deltas, precomputed once and shared by all acceptance kernels."""
+    """Per-slot state deltas, precomputed once and shared by all acceptance kernels.
+
+    During cumulative admission the same structure carries score-ordered
+    cumulative deltas instead of single-action deltas — the acceptance kernels
+    are agnostic to which they are given.
+    """
 
     src_broker: jax.Array   # i32[K]
     dst_broker: jax.Array   # i32[K]
     partition: jax.Array    # i32[K]
-    delta_src: jax.Array    # f32[K, 4] load change on the source broker
+    delta_src: jax.Array    # f32[K, 4] load change on the source broker (≤ 0)
     delta_dst: jax.Array    # f32[K, 4] load change on the destination broker
     count_delta: jax.Array       # i32[K] replica-count change at dst (+1 move, 0 other)
     leader_delta_src: jax.Array  # i32[K] leader-count change at src
     leader_delta_dst: jax.Array  # i32[K] leader-count change at dst
+    pnw_delta_dst: jax.Array     # f32[K] potential-NW-out change at dst
+    lbi_delta_dst: jax.Array     # f32[K] leader-bytes-in change at dst
     valid: jax.Array        # bool[K]
 
 
@@ -86,7 +111,6 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
     kind = moves.kind
     is_move = kind == KIND_REPLICA_MOVE
     is_lead = kind == KIND_LEADERSHIP
-    is_swap = kind == KIND_SWAP
 
     rb = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
     ldelta = state.leadership_delta[p]
@@ -113,6 +137,25 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
     ldst = -lsrc
     cnt = jnp.where(is_move, 1, 0)
 
+    # Potential NW out (PotentialNwOutGoal): every replica contributes its
+    # partition-leader's NW_OUT; leadership transfer doesn't change it.
+    from cruise_control_tpu.core.resources import Resource
+
+    leader_nw = state.base_load[r, Resource.NW_OUT] + state.leadership_delta[p, Resource.NW_OUT]
+    partner_nw = (
+        state.base_load[rb, Resource.NW_OUT]
+        + state.leadership_delta[state.replica_partition[rb], Resource.NW_OUT]
+    )
+    pnw = jnp.where(is_move, leader_nw, jnp.where(is_lead, 0.0, leader_nw - partner_nw))
+
+    # Leader bytes-in (LeaderBytesInDistributionGoal): NW_IN attributed to the
+    # leader replica follows the leadership.
+    nw_in_r = eff[r, Resource.NW_IN]
+    nw_in_rb = eff[rb, Resource.NW_IN]
+    lbi_move = jnp.where(r_leads, nw_in_r, 0.0)
+    lbi_swap = jnp.where(r_leads, nw_in_r, 0.0) - jnp.where(rb_leads, nw_in_rb, 0.0)
+    lbi = jnp.where(is_move, lbi_move, jnp.where(is_lead, nw_in_r, lbi_swap))
+
     z = jnp.int32(0)
     return MoveEffects(
         src_broker=src,
@@ -123,6 +166,8 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
         count_delta=jnp.where(ok, cnt, z),
         leader_delta_src=jnp.where(ok, lsrc, z),
         leader_delta_dst=jnp.where(ok, ldst, z),
+        pnw_delta_dst=jnp.where(ok, pnw, 0.0),
+        lbi_delta_dst=jnp.where(ok, lbi, 0.0),
         valid=ok,
     )
 
@@ -144,20 +189,138 @@ def _keep_best_per_key(
     return hit & (idx == first[k])
 
 
+def _score_rank(moves: MoveBatch, candidate: jax.Array) -> jax.Array:
+    """i32[K]: admission order (0 = first), non-candidates last, ties by index."""
+    neg = jnp.float32(-3e38)
+    s = jnp.where(candidate, moves.score, neg)
+    order = jnp.argsort(-s, stable=True)
+    K = moves.num_slots
+    return jnp.zeros(K, jnp.int32).at[order].set(jnp.arange(K, dtype=jnp.int32))
+
+
+def _segment_rank_cumsum(vals: jax.Array, key: jax.Array, rank: jax.Array) -> jax.Array:
+    """f32[K, C]: per-slot inclusive cumsum of ``vals`` over slots sharing ``key``,
+    accumulated in ``rank`` order.  ``vals`` must be ≥ 0 elementwise (monotone
+    prefix argument; callers pass positive/negative parts)."""
+    K = vals.shape[0]
+    order = jnp.lexsort((rank, key))  # by key, then admission rank — no overflow
+    v = vals[order]
+    kk = key[order]
+    c = jnp.cumsum(v, axis=0, dtype=v.dtype)
+    e = c - v  # exclusive cumsum, nondecreasing per channel within a segment
+    is_start = jnp.concatenate([jnp.ones(1, bool), kk[1:] != kk[:-1]])
+    seg_id = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    base = jax.ops.segment_min(e, seg_id, num_segments=K)  # value at segment start
+    cum_incl = e - base[seg_id] + v
+    return jnp.zeros_like(vals).at[order].set(cum_incl)
+
+
+def cumulative_effects(
+    state: ClusterArrays, moves: MoveBatch, eff: MoveEffects, candidate: jax.Array
+) -> MoveEffects:
+    """MoveEffects whose deltas are score-ordered cumulative sums per broker.
+
+    Destination channels accumulate positive parts over slots sharing a
+    destination broker; source channels accumulate negative parts over slots
+    sharing a source broker.  Conservative on both sides: a slot that passes
+    acceptance with these deltas is safe to apply together with every
+    better-scored candidate (see module docstring).
+    """
+    rank = _score_rank(moves, candidate)
+    cmask = candidate
+
+    dst_pos = jnp.concatenate(
+        [
+            jnp.maximum(eff.delta_dst, 0.0),
+            jnp.maximum(eff.count_delta, 0)[:, None].astype(jnp.float32),
+            jnp.maximum(eff.leader_delta_dst, 0)[:, None].astype(jnp.float32),
+            jnp.maximum(eff.pnw_delta_dst, 0.0)[:, None],
+            jnp.maximum(eff.lbi_delta_dst, 0.0)[:, None],
+        ],
+        axis=1,
+    )
+    dst_pos = jnp.where(cmask[:, None], dst_pos, 0.0)
+    src_neg = jnp.concatenate(
+        [
+            jnp.maximum(-eff.delta_src, 0.0),
+            jnp.maximum(-eff.leader_delta_src, 0)[:, None].astype(jnp.float32),
+        ],
+        axis=1,
+    )
+    src_neg = jnp.where(cmask[:, None], src_neg, 0.0)
+
+    cum_dst = _segment_rank_cumsum(dst_pos, eff.dst_broker, rank)
+    cum_src = _segment_rank_cumsum(src_neg, eff.src_broker, rank)
+
+    return MoveEffects(
+        src_broker=eff.src_broker,
+        dst_broker=eff.dst_broker,
+        partition=eff.partition,
+        delta_src=-cum_src[:, :4],
+        delta_dst=cum_dst[:, :4],
+        count_delta=jnp.round(cum_dst[:, 4]).astype(jnp.int32),
+        leader_delta_src=-jnp.round(cum_src[:, 4]).astype(jnp.int32),
+        leader_delta_dst=jnp.round(cum_dst[:, 5]).astype(jnp.int32),
+        pnw_delta_dst=cum_dst[:, 6],
+        lbi_delta_dst=cum_dst[:, 7],
+        valid=eff.valid & cmask,
+    )
+
+
+def admit(
+    state: ClusterArrays,
+    ctx,
+    snap,
+    moves: MoveBatch,
+    accepted: jax.Array,
+    eff: "MoveEffects | None" = None,
+    admit_mask: "jax.Array | None" = None,
+) -> jax.Array:
+    """bool[K]: the subset of accepted slots safe to apply simultaneously.
+
+    ``accepted`` is the per-slot single-action acceptance (prior goals, pre-round
+    snapshot).  ``admit_mask`` names the goals whose per-broker budgets bound the
+    cumulative admission (normally prior goals plus the goal driving the round).
+    """
+    from cruise_control_tpu.analyzer.acceptance import accept_all
+
+    if eff is None:
+        eff = move_effects(state, moves)
+    keep = accepted & eff.valid
+    # exactly one action per partition per round (partition-level invariants)
+    keep = _keep_best_per_key(keep, eff.partition, moves.score, state.num_partitions)
+
+    is_swap = moves.kind == KIND_SWAP
+
+    def _swap_admit(keep):
+        # swaps exchange signed loads: fall back to one action per broker, which
+        # keeps single-action acceptance against the pre-round snapshot exact
+        k2 = _keep_best_per_key(keep, eff.dst_broker, moves.score, state.num_brokers)
+        k2 = _keep_best_per_key(k2, eff.src_broker, moves.score, state.num_brokers)
+        dst_part = state.replica_partition[
+            jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+        ]
+        return _keep_best_per_key(k2, dst_part, moves.score, state.num_partitions)
+
+    def _cumulative_admit(keep):
+        if admit_mask is None:
+            return keep
+        eff_cum = cumulative_effects(state, moves, eff, keep)
+        return keep & accept_all(state, ctx, snap, moves, eff_cum, admit_mask)
+
+    return jax.lax.cond(is_swap, _swap_admit, _cumulative_admit, keep)
+
+
 def resolve_conflicts(
     state: ClusterArrays,
     moves: MoveBatch,
     accepted: jax.Array,
     eff: "MoveEffects | None" = None,
 ) -> jax.Array:
-    """bool[K]: conflict-free subset of accepted slots, best-score-first.
+    """Legacy conservative resolution: ≤1 action per src/dst broker + partition.
 
-    Guarantees per round: ≤1 action per destination broker and per source broker
-    (so per-endpoint acceptance checks evaluated against the pre-round state remain
-    valid after the whole batch is applied — fill-type rounds emit one slot per
-    *destination*, so several could otherwise drain one source at once) and ≤1
-    action per partition (so partition-level invariants — rack-awareness, single
-    leader — can't be broken by two simultaneously-applied actions).
+    Kept for callers that admit without a snapshot/context (e.g. compile checks);
+    the optimizer uses :func:`admit`.
     """
     if eff is None:
         eff = move_effects(state, moves)
@@ -165,15 +328,15 @@ def resolve_conflicts(
     keep = _keep_best_per_key(keep, eff.partition, moves.score, state.num_partitions)
     keep = _keep_best_per_key(keep, eff.dst_broker, moves.score, state.num_brokers)
     keep = _keep_best_per_key(keep, eff.src_broker, moves.score, state.num_brokers)
-    # swaps touch the destination *replica*'s partition too — serialize on it as well
     is_swap = moves.kind == KIND_SWAP
-    dst_part = state.replica_partition[jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)]
+    dst_part = state.replica_partition[
+        jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+    ]
 
     def _swap_dedup(keep):
         return _keep_best_per_key(keep, dst_part, moves.score, state.num_partitions)
 
-    keep = jax.lax.cond(is_swap, _swap_dedup, lambda k: k, keep)
-    return keep
+    return jax.lax.cond(is_swap, _swap_dedup, lambda k: k, keep)
 
 
 def apply_moves(state: ClusterArrays, moves: MoveBatch, keep: jax.Array) -> ClusterArrays:

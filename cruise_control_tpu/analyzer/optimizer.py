@@ -38,7 +38,7 @@ from cruise_control_tpu.analyzer.goal_rounds import (
     offline_round,
     offline_round_relaxed,
 )
-from cruise_control_tpu.analyzer.moves import apply_moves, move_effects, resolve_conflicts
+from cruise_control_tpu.analyzer.moves import admit, apply_moves, move_effects
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal, diff as diff_proposals
 from cruise_control_tpu.model import stats as S
 from cruise_control_tpu.model.arrays import ClusterArrays
@@ -46,6 +46,39 @@ from cruise_control_tpu.model.arrays import ClusterArrays
 
 class OptimizationFailure(Exception):
     """A hard goal could not be satisfied (OptimizationFailureException)."""
+
+
+#: KafkaCruiseControlUtils.java:102
+MAX_BALANCEDNESS_SCORE = 100.0
+#: AnalyzerConfig.java:375,385 — goal.balancedness.priority/strictness.weight
+DEFAULT_PRIORITY_WEIGHT = 1.1
+DEFAULT_STRICTNESS_WEIGHT = 1.5
+
+
+def balancedness_cost_by_goal(
+    goal_ids: Sequence[int],
+    hard_ids,
+    priority_weight: float = DEFAULT_PRIORITY_WEIGHT,
+    strictness_weight: float = DEFAULT_STRICTNESS_WEIGHT,
+) -> Dict[int, float]:
+    """Cost of violating each goal, summing to MAX_BALANCEDNESS_SCORE.
+
+    Mirrors ``KafkaCruiseControlUtils.balancednessCostByGoal`` (:844): walking
+    from the lowest-priority goal up, each level multiplies the weight by
+    ``priority_weight``; hard goals are further scaled by ``strictness_weight``;
+    costs are normalized to sum to the maximum score.
+    """
+    if not goal_ids:
+        return {}
+    costs: Dict[int, float] = {}
+    weight = 1.0
+    total = 0.0
+    for gid in reversed(list(goal_ids)):
+        cost = weight * (strictness_weight if gid in hard_ids else 1.0)
+        costs[gid] = cost
+        total += cost
+        weight *= priority_weight
+    return {g: MAX_BALANCEDNESS_SCORE * c / total for g, c in costs.items()}
 
 
 @dataclasses.dataclass
@@ -93,31 +126,41 @@ class OptimizerResult:
 
     @property
     def balancedness_score(self) -> float:
-        """Weighted share of satisfied goals ∈ [0, 1] — the balancedness gauge the
-        reference keeps per GoalViolationDetector (simplified weighting: hard
-        goals count double)."""
-        num = den = 0.0
+        """Balancedness gauge ∈ [0, 100]: MAX minus the weighted cost of each
+        violated goal, mirroring ``KafkaCruiseControlUtils.balancednessCostByGoal``
+        (:844) as used by GoalViolationDetector — priority weight 1.1 per level,
+        strictness weight 1.5 for hard goals."""
+        ids = [r.goal_id for r in self.goal_reports]
+        hard = {r.goal_id for r in self.goal_reports if r.is_hard}
+        costs = balancedness_cost_by_goal(ids, hard)
+        score = MAX_BALANCEDNESS_SCORE
         for r in self.goal_reports:
-            w = 2.0 if r.is_hard else 1.0
-            den += w
-            num += w if r.satisfied else 0.0
-        return num / den if den else 1.0
+            if not r.satisfied:
+                score -= costs[r.goal_id]
+        return score
 
 
 # ---------------------------------------------------------------------------
 
 
 @partial(jax.jit, static_argnames=("round_fn", "max_rounds", "enable_heavy"))
-def _phase(state, ctx, prior_mask, *, round_fn, max_rounds, enable_heavy):
-    """Drive one round type to convergence inside a single compiled while loop."""
+def _phase(state, ctx, prior_mask, admit_mask, *, round_fn, max_rounds, enable_heavy):
+    """Drive one round type to convergence inside a single compiled while loop.
+
+    ``prior_mask`` gates single-action acceptance (the hard "later goals never
+    violate earlier ones" contract); ``admit_mask`` (normally prior ∪ current
+    goal) bounds the score-ordered cumulative admission that lets many actions
+    per broker land in one round (moves.admit).  The round number feeds the
+    proposers as a tie-breaking salt.
+    """
 
     def body(carry):
         state, it, total, _ = carry
         snap = take_snapshot(state, ctx, enable_heavy)
-        moves = round_fn(state, ctx, snap)
+        moves = round_fn(state, ctx, snap, prior_mask, it)
         eff = move_effects(state, moves)
         ok = moves.valid & accept_all(state, ctx, snap, moves, eff, prior_mask)
-        keep = resolve_conflicts(state, moves, ok, eff)
+        keep = admit(state, ctx, snap, moves, ok, eff, admit_mask)
         n = keep.sum().astype(jnp.int32)
         state = apply_moves(state, moves, keep)
         return state, it + 1, total + n, n
@@ -184,9 +227,13 @@ class GoalOptimizer:
         no_prior = _mask_of(())
 
         # Pre-phase: self-healing relocation of offline replicas (dead broker/disk).
-        for fn in (offline_round, offline_round_relaxed):
+        # The strict pass bounds cumulative admission by the hard goals (so the
+        # repair lands feasibly when it can); the relaxed pass bounds nothing —
+        # draining dead brokers beats transient overload (goals rebalance after).
+        hard_mask = _mask_of(tuple(g for g in self.hard_ids if g in self.goal_ids))
+        for fn, amask in ((offline_round, hard_mask), (offline_round_relaxed, no_prior)):
             state, _, _ = _phase(
-                state, ctx, no_prior,
+                state, ctx, no_prior, amask,
                 round_fn=fn, max_rounds=self.max_rounds_per_phase, enable_heavy=heavy,
             )
 
@@ -200,10 +247,11 @@ class GoalOptimizer:
             g0 = time.monotonic()
             before = float(viol_cur[gid])
             prior_mask = _mask_of(prior)
+            admit_mask = _mask_of(prior + (gid,))
             rounds = moves = 0
             for round_fn in GOAL_ROUNDS[gid]:
                 state, r, m = _phase(
-                    state, ctx, prior_mask,
+                    state, ctx, prior_mask, admit_mask,
                     round_fn=round_fn,
                     max_rounds=self.max_rounds_per_phase,
                     enable_heavy=heavy,

@@ -2,13 +2,23 @@
 
 The reference's ``AbstractGoal.optimize`` walks brokers sequentially, and per broker
 walks ``SortedReplicas`` candidates, applying one action at a time
-(AbstractGoal.java:82-135).  The TPU formulation turns one sweep into a *round*: every
-source broker simultaneously nominates its best candidate replica (a segment-argmax —
-the array analogue of the sorted-replica walk), every candidate picks its best eligible
-destination (a masked row argmax), and the optimizer applies the conflict-free subset.
-Rounds repeat until no action survives, which plays the role of ``_finished``.
+(AbstractGoal.java:82-135).  The TPU formulation turns one sweep into a *round*:
+every source broker simultaneously nominates its **top-k** candidate replicas (a
+segmented top-k — the array analogue of the sorted-replica walk), every candidate
+picks its best eligible destination among those **pre-accepted by every prior goal**
+(``move_dst_matrix`` — the batched analogue of the reference trying the next
+destination when one is vetoed), and the optimizer admits the cumulative-safe subset
+(see ``moves.admit``).  Rounds repeat until no action survives, which plays the role
+of ``_finished``.
 
-All proposers return a :class:`MoveBatch` with one slot per broker.
+Two details matter for liveness:
+
+* destination choice consults prior-goal acceptance — a deterministic proposer that
+  ignores it can livelock forever re-proposing a vetoed destination;
+* tie-breaking jitter is salted with the round number, so equal-scored choices
+  rotate across rounds instead of deterministically re-colliding.
+
+All proposers return a :class:`MoveBatch` with ``top_k`` slots per broker.
 """
 
 from __future__ import annotations
@@ -18,16 +28,22 @@ from typing import Callable, Tuple
 import jax
 import jax.numpy as jnp
 
+from cruise_control_tpu.analyzer.acceptance import (
+    leadership_target_ok,
+    move_dst_matrix,
+    swap_dst_matrix,
+)
 from cruise_control_tpu.analyzer.context import NEG, GoalContext, Snapshot, segment_argmax
 from cruise_control_tpu.analyzer.moves import (
     KIND_LEADERSHIP,
     KIND_REPLICA_MOVE,
+    KIND_SWAP,
     MoveBatch,
 )
 from cruise_control_tpu.model.arrays import ClusterArrays
 
-# dst_fn(cand_replica i32[B]) -> (eligible bool[B, B], score f32[B, B]); row = source
-# broker slot, column = destination broker.
+# dst_fn(cand_replica i32[S]) -> (eligible bool[S, B], score f32[S, B]); row = slot,
+# column = destination broker.
 DstFn = Callable[[jax.Array], Tuple[jax.Array, jax.Array]]
 
 #: Tie-break magnitude for destination choice.  Must stay below meaningful score
@@ -35,23 +51,42 @@ DstFn = Callable[[jax.Array], Tuple[jax.Array, jax.Array]]
 TIEBREAK = jnp.float32(1e-4)
 
 
-def _pair_jitter(a: jax.Array, b: jax.Array) -> jax.Array:
-    """f32 in (-TIEBREAK, 0]: deterministic jitter from an (a, b) index pair
-    (broadcasting); shared by every proposer's tie-breaking."""
-    h = a * jnp.int32(1103515245) + b * jnp.int32(40503)
+def _pair_jitter(a: jax.Array, b: jax.Array, salt: jax.Array = 0) -> jax.Array:
+    """f32 in (-TIEBREAK, 0]: deterministic jitter from an (a, b, salt) index tuple
+    (broadcasting); shared by every proposer's tie-breaking.  ``salt`` (the round
+    number) rotates the tie order per round so deterministic collisions can't repeat."""
+    s = jnp.asarray(salt, jnp.int32)
+    h = a * jnp.int32(1103515245) + b * jnp.int32(40503) + s * jnp.int32(1013904223)
     h = jnp.bitwise_and(h ^ (h >> 7), jnp.int32(1023))
     return -TIEBREAK * h.astype(jnp.float32) / 1024.0
 
 
-def _cyclic_tiebreak(num_rows: int, num_cols: int, row_ids: jax.Array) -> jax.Array:
-    """f32[rows, cols] in (-TIEBREAK, 0]: per-(row, col) jitter so equal-scored
-    destinations spread across sources — without this, every source picks the same
-    "best" destination and per-destination conflict dedup serializes the whole
-    round to one action.  A plain cyclic offset is not enough (contiguous source
-    blocks all prefer the same first eligible column), hence the hash.
-    """
+def _cyclic_tiebreak(row_ids: jax.Array, num_cols: int, salt: jax.Array) -> jax.Array:
+    """f32[rows, cols] in (-TIEBREAK, 0]: per-(row, col, round) jitter so
+    equal-scored destinations spread across sources — without this, every source
+    picks the same "best" destination and per-destination admission throttles the
+    round.  A plain cyclic offset is not enough (contiguous source blocks all
+    prefer the same first eligible column), hence the hash."""
     cols = jnp.arange(num_cols, dtype=jnp.int32)[None, :]
-    return _pair_jitter(row_ids[:, None], cols)
+    return _pair_jitter(row_ids[:, None], cols, salt)
+
+
+def topk_segment_argmax(
+    scores: jax.Array, seg: jax.Array, num_segments: int, eligible: jax.Array, k: int
+) -> jax.Array:
+    """i32[k, num_segments]: top-k eligible elements per segment by score, -1-padded.
+
+    The batched replacement for walking the first k entries of ``SortedReplicas``
+    (SortedReplicas.java:47)."""
+    rows = []
+    el = eligible
+    oob = jnp.int32(scores.shape[0])
+    for _ in range(k):
+        idx = segment_argmax(scores, seg, num_segments, el)
+        rows.append(idx)
+        chosen = jnp.where(idx >= 0, idx, oob)
+        el = el.at[chosen].set(False, mode="drop")
+    return jnp.stack(rows)
 
 
 def _partition_occupancy(
@@ -88,25 +123,39 @@ def _partition_occupancy(
 
 def shed_round(
     state: ClusterArrays,
+    ctx: GoalContext,
     snap: Snapshot,
+    prior_mask: jax.Array,
+    salt: jax.Array,
     src_need: jax.Array,     # f32[B] > 0 ⇒ broker must shed
     cand_score: jax.Array,   # f32[R] preference among its broker's replicas
     cand_ok: jax.Array,      # bool[R]
     dst_fn: DstFn,
 ) -> MoveBatch:
-    """One replica-move round pushing load out of violating brokers."""
+    """One replica-move round pushing load out of violating brokers.
+
+    Each active source nominates its top-k candidates; each candidate picks the
+    best destination among those acceptable to every prior goal."""
     B = state.num_brokers
+    k = ctx.top_k
+    S = k * B
     active = src_need > 0
-    cand = segment_argmax(cand_score, state.replica_broker, B, cand_ok)
-    valid = active & (cand >= 0)
+    cands = topk_segment_argmax(cand_score, state.replica_broker, B, cand_ok, k)
+    cand = cands.reshape(-1)                                   # slot = j·B + b
+    src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
+    valid = active[src_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
 
     elig, score = dst_fn(cand_safe)
     cols = jnp.arange(B, dtype=jnp.int32)
-    not_self = cols[None, :] != state.replica_broker[cand_safe][:, None]
+    not_self = cols[None, :] != src_of_slot[:, None]
     elig = elig & snap.dest_ok[None, :] & not_self & valid[:, None]
-    elig = elig & ~_partition_occupancy(state, cand_safe, cand >= 0)
-    score = score + _cyclic_tiebreak(B, B, cols)
+    elig = elig & move_dst_matrix(state, ctx, snap, cand_safe, valid, prior_mask)
+    # occupancy claims restricted to *valid* slots — an inactive broker's candidate
+    # must not steal the partition slot from an active source (it would fully mask
+    # the active slot via ~unique and livelock the round)
+    elig = elig & ~_partition_occupancy(state, cand_safe, valid)
+    score = score + _pair_jitter(cand_safe[:, None], cols[None, :], salt)
     score = jnp.where(elig, score, NEG)
     dst = jnp.argmax(score, axis=1).astype(jnp.int32)
     found = jnp.take_along_axis(score, dst[:, None], axis=1)[:, 0] > NEG / 2
@@ -116,14 +165,17 @@ def shed_round(
         kind=jnp.asarray(KIND_REPLICA_MOVE, jnp.int32),
         replica=replica,
         dst_broker=jnp.where(replica >= 0, dst, -1),
-        dst_replica=jnp.full(B, -1, jnp.int32),
-        score=jnp.where(replica >= 0, src_need, 0.0),
+        dst_replica=jnp.full(S, -1, jnp.int32),
+        score=jnp.where(replica >= 0, src_need[src_of_slot], 0.0),
     )
 
 
 def fill_round(
     state: ClusterArrays,
+    ctx: GoalContext,
     snap: Snapshot,
+    prior_mask: jax.Array,
+    salt: jax.Array,
     dst_need: jax.Array,      # f32[B] > 0 ⇒ broker wants load in
     donor_score: jax.Array,   # f32[R] preference among a donor broker's replicas
     donor_ok: jax.Array,      # bool[R]
@@ -133,39 +185,78 @@ def fill_round(
     """One replica-move round pulling load into under-limit brokers.
 
     Mirrors the move-in direction of ``ResourceDistributionGoal.rebalanceForBroker``
-    (:380-435): each needy broker picks the best donor broker's top candidate.
+    (:380-435): each needy broker picks its top-k donor brokers; donor replicas are
+    rotated across destinations so simultaneous fills don't collide on one replica.
     """
     B = state.num_brokers
+    k = ctx.top_k
     active = dst_need > 0
-    cand = segment_argmax(donor_score, state.replica_broker, B, donor_ok)
-    cand_safe = jnp.where(cand >= 0, cand, 0)
+    # top-k candidate replicas per donor broker (rotated across destinations)
+    cands_k = topk_segment_argmax(donor_score, state.replica_broker, B, donor_ok, k)
+    cand0 = cands_k[0]
+    cand0_safe = jnp.where(cand0 >= 0, cand0, 0)
 
-    fits, sscore = fit_fn(cand_safe)   # rows = destination, cols = donor broker
+    fits, sscore = fit_fn(cand0_safe)   # rows = destination, cols = donor broker
     cols = jnp.arange(B, dtype=jnp.int32)
-    has_cand = (cand >= 0)[None, :]
+    has_cand = (cand0 >= 0)[None, :]
     not_self = cols[None, :] != cols[:, None]
     dst_is_ok = (snap.dest_ok & active)[:, None]
     fits = fits & has_cand & not_self & dst_is_ok
-    # rows = destination broker, so transpose the per-candidate occupancy
-    fits = fits & ~_partition_occupancy(state, cand_safe, cand >= 0).T
-    sscore = sscore + _cyclic_tiebreak(B, B, cols)
+    # [donor_slot, dst] acceptance, transposed to [dst, donor]
+    fits = fits & move_dst_matrix(state, ctx, snap, cand0_safe, cand0 >= 0, prior_mask).T
+    fits = fits & ~_partition_occupancy(state, cand0_safe, cand0 >= 0).T
+    sscore = sscore + _cyclic_tiebreak(cols, B, salt)
     sscore = jnp.where(fits, sscore, NEG)
-    donor = jnp.argmax(sscore, axis=1).astype(jnp.int32)
-    found = jnp.take_along_axis(sscore, donor[:, None], axis=1)[:, 0] > NEG / 2
 
-    replica = jnp.where(active & found, cand_safe[donor], -1)
+    # pick top-k donor columns per destination row
+    replicas, dsts, needs = [], [], []
+    n_cands = jnp.maximum((cands_k >= 0).sum(axis=0), 1).astype(jnp.int32)  # per donor
+    masked = sscore
+    for j in range(k):
+        donor = jnp.argmax(masked, axis=1).astype(jnp.int32)
+        found = jnp.take_along_axis(masked, donor[:, None], axis=1)[:, 0] > NEG / 2
+        masked = masked.at[cols, donor].set(NEG)
+        # rotate which of the donor's top candidates this destination takes, so
+        # two destinations sharing a donor usually receive different replicas;
+        # modulo the donor's actual candidate count (cands_k is -1-padded) so a
+        # short donor still always offers its first candidate
+        rot = (jnp.arange(B, dtype=jnp.int32) + j + jnp.asarray(salt, jnp.int32)) % n_cands[donor]
+        r_j = cands_k[rot, donor]
+        ok = active & found & (r_j >= 0)
+        replicas.append(jnp.where(ok, r_j, -1))
+        dsts.append(jnp.where(ok, cols, -1))
+        needs.append(jnp.where(ok, dst_need, 0.0))
+    replica = jnp.concatenate(replicas)
+    dstv = jnp.concatenate(dsts)
+    need = jnp.concatenate(needs)
+
+    # The donor columns were vetted with each donor's TOP candidate; rotated
+    # replicas must re-pass prior-goal acceptance and partition occupancy for
+    # their specific destination (exact per-(slot, dst) gather).
+    K = k * B
+    slot_valid = replica >= 0
+    r_safe = jnp.where(slot_valid, replica, 0)
+    d_safe = jnp.where(slot_valid, dstv, 0)
+    rows = jnp.arange(K, dtype=jnp.int32)
+    pair_ok = move_dst_matrix(state, ctx, snap, r_safe, slot_valid, prior_mask)[rows, d_safe]
+    pair_ok &= ~_partition_occupancy(state, r_safe, slot_valid)[rows, d_safe]
+    pair_ok &= d_safe != state.replica_broker[r_safe]
+    replica = jnp.where(slot_valid & pair_ok, replica, -1)
     return MoveBatch(
         kind=jnp.asarray(KIND_REPLICA_MOVE, jnp.int32),
         replica=replica,
-        dst_broker=jnp.where(replica >= 0, cols, -1),
-        dst_replica=jnp.full(B, -1, jnp.int32),
-        score=jnp.where(replica >= 0, dst_need, 0.0),
+        dst_broker=jnp.where(replica >= 0, dstv, -1),
+        dst_replica=jnp.full(K, -1, jnp.int32),
+        score=jnp.where(replica >= 0, need, 0.0),
     )
 
 
 def leadership_shed_round(
     state: ClusterArrays,
+    ctx: GoalContext,
     snap: Snapshot,
+    prior_mask: jax.Array,
+    salt: jax.Array,
     src_need: jax.Array,       # f32[B] > 0 ⇒ broker must shed leadership load
     leader_score: jax.Array,   # f32[R] preference among the broker's leader replicas
     leader_ok: jax.Array,      # bool[R] leader may surrender leadership
@@ -175,22 +266,25 @@ def leadership_shed_round(
     """One leadership-transfer round (the "leadership movement first" phase of
     NW_OUT/CPU balancing, ResourceDistributionGoal.java:380)."""
     B, P = state.num_brokers, state.num_partitions
+    k = ctx.top_k
     take_ok = (
         follower_ok & snap.leader_movable & ~snap.is_leader
         & snap.topic_allowed & state.replica_valid
+        & leadership_target_ok(state, ctx, snap, prior_mask)
     )
     # per-partition jitter among equal-scored takeover brokers — otherwise every
-    # partition promotes a follower on the same broker and per-destination dedup
-    # serializes the round (see _cyclic_tiebreak)
+    # partition promotes a follower on the same broker and admission throttles
     fb = state.replica_broker
-    tb = _pair_jitter(state.replica_partition, fb)
+    tb = _pair_jitter(state.replica_partition, fb, salt)
     best_follower = segment_argmax(follower_score + tb, state.replica_partition, P, take_ok)
 
     has_follower = best_follower[state.replica_partition] >= 0
     give_ok = leader_ok & snap.is_leader & has_follower
-    cand = segment_argmax(leader_score, state.replica_broker, B, give_ok)
+    cands = topk_segment_argmax(leader_score, state.replica_broker, B, give_ok, k)
+    cand = cands.reshape(-1)
+    src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
     active = src_need > 0
-    valid = active & (cand >= 0)
+    valid = active[src_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
     p = state.replica_partition[cand_safe]
     dst_rep = best_follower[p]
@@ -202,27 +296,34 @@ def leadership_shed_round(
         replica=replica,
         dst_broker=jnp.where(replica >= 0, state.replica_broker[dst_rep_safe], -1),
         dst_replica=jnp.where(replica >= 0, dst_rep, -1),
-        score=jnp.where(replica >= 0, src_need, 0.0),
+        score=jnp.where(replica >= 0, src_need[src_of_slot], 0.0),
     )
 
 
 def leadership_fill_round(
     state: ClusterArrays,
+    ctx: GoalContext,
     snap: Snapshot,
+    prior_mask: jax.Array,
+    salt: jax.Array,
     dst_need: jax.Array,       # f32[B] > 0 ⇒ broker wants more leadership
     follower_score: jax.Array,  # f32[R] preference among the broker's followers
     follower_ok: jax.Array,    # bool[R] follower may take leadership *here*
 ) -> MoveBatch:
     """One leadership round pulling leadership onto needy brokers: each needy broker
-    promotes one of its own followers (whose current leader sits elsewhere)."""
+    promotes its top-k followers (whose current leaders sit elsewhere)."""
     B = state.num_brokers
+    k = ctx.top_k
     take_ok = (
         follower_ok & snap.leader_movable & ~snap.is_leader
         & snap.topic_allowed & state.replica_valid
+        & leadership_target_ok(state, ctx, snap, prior_mask)
     )
-    cand = segment_argmax(follower_score, state.replica_broker, B, take_ok)
+    cands = topk_segment_argmax(follower_score, state.replica_broker, B, take_ok, k)
+    cand = cands.reshape(-1)
+    dst_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
     active = dst_need > 0
-    valid = active & (cand >= 0)
+    valid = active[dst_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
     p = state.replica_partition[cand_safe]
     cur_leader = state.partition_leader[p]
@@ -232,7 +333,88 @@ def leadership_fill_round(
     return MoveBatch(
         kind=jnp.asarray(KIND_LEADERSHIP, jnp.int32),
         replica=replica,
-        dst_broker=jnp.where(ok, jnp.arange(B, dtype=jnp.int32), -1),
+        dst_broker=jnp.where(ok, dst_of_slot, -1),
         dst_replica=jnp.where(ok, cand_safe, -1),
-        score=jnp.where(ok, dst_need, 0.0),
+        score=jnp.where(ok, dst_need[dst_of_slot], 0.0),
+    )
+
+
+def swap_round(
+    state: ClusterArrays,
+    ctx: GoalContext,
+    snap: Snapshot,
+    prior_mask: jax.Array,
+    salt: jax.Array,
+    src_need: jax.Array,   # f32[B] > 0 ⇒ broker must improve by swapping load out
+    out_score: jax.Array,  # f32[R] preference for the outgoing replica (heavy first)
+    out_ok: jax.Array,     # bool[R]
+    in_score: jax.Array,   # f32[R] preference for the incoming partner (light first)
+    in_ok: jax.Array,      # bool[R]
+    gain_fn: Callable[[jax.Array, jax.Array], Tuple[jax.Array, jax.Array]],
+    # gain_fn(r_out i32[S], partner i32[B]) -> (ok bool[S, B], gain f32[S, B])
+) -> MoveBatch:
+    """One pairwise-swap round: overloaded brokers exchange a heavy replica for an
+    underloaded broker's light one.
+
+    The batched analogue of ``ResourceDistributionGoal.rebalanceBySwappingLoadOut``
+    (ResourceDistributionGoal.java:599): when plain moves stall (every destination
+    vetoed or full), a swap sheds net load while keeping replica counts intact.
+    Each destination broker nominates one partner replica per round (rotated by
+    ``salt``); each overloaded source nominates its top-k outgoing replicas; the
+    ``[S, B]`` pairing is filtered by the goal's ``gain_fn``, both directions of
+    prior-goal acceptance, partition distinctness and occupancy.  Swap admission
+    stays one-action-per-broker (signed deltas are not monotone), so swap rounds
+    trade throughput for reach — they run after the move rounds converge.
+    """
+    B = state.num_brokers
+    k = ctx.top_k
+    active = src_need > 0
+
+    # one incoming partner per destination broker, rotated across rounds
+    # (jitter keyed on the replica index so in-segment ties actually rotate)
+    R = state.num_replicas
+    pj = _pair_jitter(jnp.arange(R, dtype=jnp.int32), jnp.int32(97), salt)
+    partner = segment_argmax(in_score + pj, state.replica_broker, B, in_ok)
+    partner_valid = partner >= 0
+    partner_safe = jnp.where(partner_valid, partner, 0)
+    p_in = state.replica_partition[partner_safe]
+
+    # top-k outgoing replicas per active source
+    cands = topk_segment_argmax(out_score, state.replica_broker, B, out_ok, k)
+    cand = cands.reshape(-1)
+    src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
+    valid = active[src_of_slot] & (cand >= 0)
+    cand_safe = jnp.where(cand >= 0, cand, 0)
+    p_out = state.replica_partition[cand_safe]
+
+    ok, gain = gain_fn(cand_safe, partner_safe)                 # [S, B]
+    cols = jnp.arange(B, dtype=jnp.int32)
+    not_self = cols[None, :] != src_of_slot[:, None]
+    ok = ok & partner_valid[None, :] & valid[:, None] & not_self
+    ok = ok & snap.dest_ok[None, :] & snap.dest_ok[src_of_slot][:, None]
+    ok = ok & (p_out[:, None] != p_in[None, :])
+    # occupancy both directions (a broker may hold one replica per partition)
+    ok = ok & ~_partition_occupancy(state, cand_safe, valid)
+    occ_in = _partition_occupancy(state, partner_safe, partner_valid)  # [B, B]
+    ok = ok & ~occ_in[:, src_of_slot].T
+    # prior-goal acceptance with the swap's NET deltas — two bare-move checks
+    # would veto exactly the pinned cases swaps exist for (e.g. replica counts
+    # at the max: a move is rejected, a count-neutral swap is fine)
+    ok = ok & swap_dst_matrix(
+        state, ctx, snap, cand_safe, valid, partner_safe, partner_valid, prior_mask
+    )
+
+    score = gain + _pair_jitter(cand_safe[:, None], cols[None, :], salt)
+    score = jnp.where(ok, score, NEG)
+    dst = jnp.argmax(score, axis=1).astype(jnp.int32)
+    found = jnp.take_along_axis(score, dst[:, None], axis=1)[:, 0] > NEG / 2
+
+    replica = jnp.where(valid & found, cand_safe, -1)
+    dst_safe = jnp.where(replica >= 0, dst, 0)
+    return MoveBatch(
+        kind=jnp.asarray(KIND_SWAP, jnp.int32),
+        replica=replica,
+        dst_broker=jnp.where(replica >= 0, dst, -1),
+        dst_replica=jnp.where(replica >= 0, partner[dst_safe], -1),
+        score=jnp.where(replica >= 0, src_need[src_of_slot], 0.0),
     )

@@ -26,6 +26,10 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.optimizer import (
+    MAX_BALANCEDNESS_SCORE,
+    balancedness_cost_by_goal,
+)
 from cruise_control_tpu.backend.base import ClusterBackend
 from cruise_control_tpu.detector.anomalies import (
     Anomaly,
@@ -58,7 +62,7 @@ class GoalViolationDetector(Detector):
     ) -> None:
         self.cc = cruise_control
         self.detection_goal_ids = tuple(detection_goal_ids)
-        self.balancedness_score: float = 1.0
+        self.balancedness_score: float = MAX_BALANCEDNESS_SCORE
         self.last_result = None
 
     def run(self) -> List[Anomaly]:
@@ -72,7 +76,15 @@ class GoalViolationDetector(Detector):
             return []
         result = op.optimizer_result
         self.last_result = result
-        self.balancedness_score = result.balancedness_score
+        # Gauge semantics follow GoalViolationDetector.java:283-285: start from
+        # the max score and subtract each *detected* (pre-fix) violated goal's
+        # priority/strictness-weighted cost.
+        ids = [r.goal_id for r in result.goal_reports]
+        hard = {r.goal_id for r in result.goal_reports if r.is_hard}
+        costs = balancedness_cost_by_goal(ids, hard)
+        self.balancedness_score = MAX_BALANCEDNESS_SCORE - sum(
+            costs[r.goal_id] for r in result.goal_reports if r.violations_before > 0
+        )
         violated = [
             name for name, v in result.violations_before.items() if v > 0
         ]

@@ -1,0 +1,23 @@
+"""Scale-out layer: device mesh, replica-axis sharding, collective primitives.
+
+See ``parallel.mesh`` (layout), ``parallel.sharded`` (explicit shard_map/psum
+primitives), ``parallel.solver`` (the mesh-sharded GoalOptimizer).
+"""
+
+from cruise_control_tpu.parallel.mesh import (
+    REPLICA_AXIS,
+    pad_replicas,
+    replicate,
+    shard_state,
+    solver_mesh,
+)
+from cruise_control_tpu.parallel.solver import ShardedGoalOptimizer
+
+__all__ = [
+    "REPLICA_AXIS",
+    "ShardedGoalOptimizer",
+    "pad_replicas",
+    "replicate",
+    "shard_state",
+    "solver_mesh",
+]

@@ -1,0 +1,135 @@
+"""Explicit replica-axis collectives (shard_map + psum/pmin building blocks).
+
+These are the four primitives the solver needs once the replica axis is sharded
+over a mesh (``parallel.mesh``), each written as an explicit per-shard kernel +
+XLA collective so the communication pattern is visible and testable:
+
+* :func:`sharded_segment_sum`   — per-broker aggregation: local segment partials,
+  one ``psum`` over the mesh (rides ICI);
+* :func:`sharded_segment_argmax` — candidate selection (``SortedReplicas`` walk):
+  local per-segment max, global ``pmax`` on scores, global ``pmin`` on the index
+  of local hits (ties break to the lowest global index, bit-identical to the
+  single-device ``analyzer.context.segment_argmax``);
+* :func:`sharded_gather`        — read replica fields at arbitrary global ids:
+  each shard contributes the ids it owns, combined with a ``psum`` (a one-hot
+  gather — O(|ids|) traffic, never an all-gather of the replica axis);
+* :func:`sharded_scatter_set`   — write back to a sharded replica array: each
+  shard applies only the updates whose global id falls in its range.
+
+The full solver phase runs under GSPMD with the same mesh (parallel.solver) —
+XLA inserts equivalent collectives automatically; these explicit forms pin down
+the intended pattern and are unit-tested for equivalence on an 8-device CPU mesh
+(tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cruise_control_tpu.parallel.mesh import REPLICA_AXIS
+
+NEG = jnp.float32(-3e38)
+
+
+def _shard_offset(total: int) -> jax.Array:
+    """Global index of this shard's first element."""
+    idx = jax.lax.axis_index(REPLICA_AXIS)
+    size = jax.lax.psum(1, REPLICA_AXIS)
+    return idx * (total // size)
+
+
+def sharded_segment_sum(mesh: Mesh, vals: jax.Array, seg: jax.Array, num_segments: int):
+    """Segment-sum a replica-sharded array into a replicated [num_segments] result."""
+
+    def kernel(v, s):
+        local = jax.ops.segment_sum(v, s, num_segments=num_segments)
+        return jax.lax.psum(local, REPLICA_AXIS)
+
+    spec_in = P(REPLICA_AXIS, *([None] * (vals.ndim - 1)))
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(spec_in, P(REPLICA_AXIS)),
+        out_specs=P(),
+    )(vals, seg)
+
+
+def sharded_segment_argmax(
+    mesh: Mesh, scores: jax.Array, seg: jax.Array, num_segments: int, eligible: jax.Array
+):
+    """Replicated i32[num_segments]: global argmax per segment, -1 when empty.
+
+    Tie-breaks to the lowest *global* replica index, matching
+    ``analyzer.context.segment_argmax`` exactly.
+    """
+    R = scores.shape[0]
+
+    def kernel(sc, sg, el):
+        s = jnp.where(el, sc, NEG)
+        local_max = jax.ops.segment_max(s, sg, num_segments=num_segments)
+        gmax = jax.lax.pmax(local_max, REPLICA_AXIS)
+        off = _shard_offset(R)
+        gidx = jnp.arange(s.shape[0], dtype=jnp.int32) + off
+        hit = el & (s >= gmax[sg]) & (s > NEG / 2)
+        big = jnp.int32(2**30)
+        local_best = jax.ops.segment_min(
+            jnp.where(hit, gidx, big), sg, num_segments=num_segments
+        )
+        best = jax.lax.pmin(local_best, REPLICA_AXIS)
+        return jnp.where(best < big, best, -1)
+
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS), P(REPLICA_AXIS)),
+        out_specs=P(),
+    )(scores, seg, eligible)
+
+
+def sharded_gather(mesh: Mesh, arr: jax.Array, ids: jax.Array):
+    """Replicated gather of a replica-sharded array at replicated global ids.
+
+    Each shard zero-fills ids outside its range; a psum assembles the answer —
+    one [|ids|]-sized all-reduce instead of all-gathering the replica axis.
+    Negative ids return 0.
+    """
+    R = arr.shape[0]
+
+    def kernel(a):
+        off = _shard_offset(R)
+        local = ids - off
+        m = a.shape[0]
+        mine = (local >= 0) & (local < m) & (ids >= 0)
+        safe = jnp.clip(local, 0, m - 1)
+        vals = a[safe]
+        zeros = jnp.zeros_like(vals)
+        picked = jnp.where(mine if vals.ndim == 1 else mine[:, None], vals, zeros)
+        return jax.lax.psum(picked, REPLICA_AXIS)
+
+    spec_in = P(REPLICA_AXIS, *([None] * (arr.ndim - 1)))
+    out_spec = P()
+    return shard_map(kernel, mesh=mesh, in_specs=(spec_in,), out_specs=out_spec)(arr)
+
+
+def sharded_scatter_set(mesh: Mesh, arr: jax.Array, ids: jax.Array, vals: jax.Array):
+    """Write replicated (ids, vals) updates into a replica-sharded array.
+
+    Each shard applies only the updates it owns (global id within its range);
+    ids < 0 are no-ops.  No communication at all — the updates are already
+    replicated.
+    """
+    R = arr.shape[0]
+
+    def kernel(a):
+        off = _shard_offset(R)
+        local = ids - off
+        m = a.shape[0]
+        mine = (local >= 0) & (local < m) & (ids >= 0)
+        tgt = jnp.where(mine, local, m)  # out-of-range drops
+        return a.at[tgt].set(vals, mode="drop")
+
+    spec = P(REPLICA_AXIS, *([None] * (arr.ndim - 1)))
+    return shard_map(kernel, mesh=mesh, in_specs=(spec,), out_specs=spec)(arr)

@@ -14,3 +14,26 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's accelerator hook overrides the env var by writing
+# "axon,cpu" straight into jax's config after import, so a plain
+# JAX_PLATFORMS=cpu still tries the (possibly unreachable) TPU tunnel first
+# and can block the whole test session on backend init.  Forcing the config
+# value after import is the only override that sticks.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Release compiled executables between test modules.
+
+    Each module compiles its own shape variants of the solver phases; keeping
+    every executable loaded for the whole session has crashed XLA's CPU
+    compiler (SIGSEGV in backend_compile_and_load) late in the run.
+    """
+    yield
+    jax.clear_caches()

@@ -147,7 +147,9 @@ class TestGoalViolationDetector:
         anomalies = det.run()
         # the skewed start must violate at least the distribution goals
         assert anomalies and anomalies[0].violated_goals
-        assert det.balancedness_score < 1.0
+        from cruise_control_tpu.analyzer.optimizer import MAX_BALANCEDNESS_SCORE
+
+        assert det.balancedness_score < MAX_BALANCEDNESS_SCORE
 
     def test_goal_violation_fix_rebalances(self):
         backend, monitor, cc = build_cc(skew=2)

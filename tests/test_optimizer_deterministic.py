@@ -162,3 +162,77 @@ class TestExcludedTopics:
         final, result = opt.optimize(state, ctx, maps=maps)
         for prop in result.proposals:
             assert prop.tp[0] != "T1"
+
+
+class TestSwaps:
+    """Swap rounds (ResourceDistributionGoal.rebalanceBySwappingLoadOut, :599):
+    when replica counts pin every broker (moves rejected by ReplicaCapacityGoal),
+    only a pairwise swap can still balance load."""
+
+    def _pinned_cluster(self):
+        from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+
+        cluster = fixtures.homogeneous_cluster({0: "0", 1: "1"})
+        heavy = fixtures.load(2.0, 100.0, 100.0, 100_000.0)
+        light = fixtures.load(2.0, 100.0, 100.0, 10_000.0)
+        for i, (broker, ld) in enumerate(
+            [(0, heavy), (0, heavy), (1, light), (1, light)]
+        ):
+            cluster.create_replica(broker, ("T1", i), 0, True)
+            cluster.set_replica_load(broker, ("T1", i), ld)
+        constraint = BalancingConstraint.default(max_replicas_per_broker=2)
+        return cluster, constraint
+
+    def test_swap_balances_when_moves_are_pinned(self):
+        cluster, constraint = self._pinned_cluster()
+        state, maps = cluster.to_arrays(pad_replicas_to=8, pad_partitions_to=8, pad_topics_to=2)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers, constraint=constraint)
+        opt = GoalOptimizer(goal_ids=(G.REPLICA_CAPACITY, G.DISK_USAGE_DIST))
+        final, result = opt.optimize(state, ctx, maps=maps)
+
+        counts = np.asarray(A.broker_replica_counts(final))
+        assert counts[0] == 2 and counts[1] == 2, "swap must preserve replica counts"
+        disk = np.asarray(A.broker_load(final))[:, Resource.DISK]
+        assert abs(disk[0] - disk[1]) < 1e-3, f"loads should equalize, got {disk}"
+        assert result.violations_after["DiskUsageDistributionGoal"] == 0
+
+    def test_swap_respects_rack_awareness(self):
+        """A swap that would co-locate two replicas of one partition in a rack is
+        vetoed by the prior RackAwareGoal."""
+        from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+
+        # brokers 0,1 in rack 0; broker 2 in rack 1.  P0 has replicas on 0 and 2
+        # (rack-safe).  P1..P4 single-replica.  Pin counts so only swaps move load.
+        cluster = fixtures.homogeneous_cluster({0: "0", 1: "0", 2: "1"})
+        heavy = fixtures.load(2.0, 100.0, 100.0, 120_000.0)
+        light = fixtures.load(2.0, 100.0, 100.0, 10_000.0)
+        cluster.create_replica(0, ("T1", 0), 0, True)   # P0 leader on b0 (rack 0)
+        cluster.set_replica_load(0, ("T1", 0), heavy)
+        cluster.create_replica(2, ("T1", 0), 1, False)  # P0 follower on b2 (rack 1)
+        cluster.set_replica_load(2, ("T1", 0), light)
+        cluster.create_replica(0, ("T1", 1), 0, True)
+        cluster.set_replica_load(0, ("T1", 1), heavy)
+        cluster.create_replica(1, ("T1", 2), 0, True)
+        cluster.set_replica_load(1, ("T1", 2), light)
+        cluster.create_replica(1, ("T1", 3), 0, True)
+        cluster.set_replica_load(1, ("T1", 3), light)
+        cluster.create_replica(2, ("T1", 4), 0, True)
+        cluster.set_replica_load(2, ("T1", 4), light)
+
+        state, maps = cluster.to_arrays(pad_replicas_to=8, pad_partitions_to=8, pad_topics_to=2)
+        constraint = BalancingConstraint.default(max_replicas_per_broker=2)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers, constraint=constraint)
+        opt = GoalOptimizer(
+            goal_ids=(G.RACK_AWARE, G.REPLICA_CAPACITY, G.DISK_USAGE_DIST)
+        )
+        final, result = opt.optimize(state, ctx, maps=maps)
+
+        # rack-awareness must hold at the end, whatever swaps happened
+        assert result.violations_after["RackAwareGoal"] == 0
+        rb = np.asarray(final.replica_broker)
+        rp = np.asarray(final.replica_partition)
+        valid = np.asarray(final.replica_valid)
+        racks = np.asarray(final.broker_rack)
+        for p in set(rp[valid].tolist()):
+            rs = racks[rb[valid & (rp == p)]]
+            assert len(set(rs.tolist())) == len(rs), f"partition {p} rack collision"
