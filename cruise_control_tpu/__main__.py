@@ -1,0 +1,11 @@
+"""``python -m cruise_control_tpu --config cruisecontrol.properties``
+
+The process entry point (KafkaCruiseControlMain.java:26).
+"""
+
+import sys
+
+from cruise_control_tpu.app import main
+
+if __name__ == "__main__":
+    sys.exit(main())

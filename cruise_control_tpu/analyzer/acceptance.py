@@ -79,20 +79,33 @@ def accept_replica_capacity(state, ctx, snap, moves, eff):
 
 
 def accept_capacity(state, ctx, snap, moves, eff, res: int):
-    """CapacityGoal (CapacityGoal.java:41): the destination must stay under
-    ``capacity_threshold · capacity``; load-reducing deltas are always fine."""
+    """CapacityGoal (CapacityGoal.java:41): both endpoints must stay under
+    ``capacity_threshold · capacity``; load-reducing deltas are always fine.
+
+    The source check matters for swaps: the partner replica arriving at the
+    source can gain load in resources other than the one the swap round
+    optimizes (the reference checks both endpoints for REPLICA_SWAP)."""
     limit = snap.cap_limits[:, res]
-    delta = eff.delta_dst[:, res]
-    after = snap.broker_load[eff.dst_broker, res] + delta
-    return (after <= limit[eff.dst_broker]) | (delta <= 0.0)
+    d_dst = eff.delta_dst[:, res]
+    dst_after = snap.broker_load[eff.dst_broker, res] + d_dst
+    ok_dst = (dst_after <= limit[eff.dst_broker]) | (d_dst <= 0.0)
+    d_src = eff.delta_src[:, res]
+    src_after = snap.broker_load[eff.src_broker, res] + d_src
+    ok_src = (src_after <= limit[eff.src_broker]) | (d_src <= 0.0)
+    return ok_dst & ok_src
 
 
 def accept_potential_nw_out(state, ctx, snap, moves, eff):
-    """PotentialNwOutGoal (:42): destination's potential outbound (every replica
-    promoted) stays within the NW_OUT capacity threshold."""
+    """PotentialNwOutGoal (:42): each endpoint's potential outbound (every
+    replica promoted) stays within the NW_OUT capacity threshold.  The source
+    delta is the exact negation of the destination's for every action kind."""
     limit = snap.cap_limits[:, Resource.NW_OUT]
     after = snap.potential_nw_out[eff.dst_broker] + eff.pnw_delta_dst
-    return (after <= limit[eff.dst_broker]) | (eff.pnw_delta_dst <= 0.0)
+    ok_dst = (after <= limit[eff.dst_broker]) | (eff.pnw_delta_dst <= 0.0)
+    src_delta = -eff.pnw_delta_dst
+    src_after = snap.potential_nw_out[eff.src_broker] + src_delta
+    ok_src = (src_after <= limit[eff.src_broker]) | (src_delta <= 0.0)
+    return ok_dst & ok_src
 
 
 def accept_replica_count_dist(state, ctx, snap, moves, eff):
@@ -125,16 +138,35 @@ def accept_resource_dist(state, ctx, snap, moves, eff, res: int):
     ok_within = (dst_after <= upper[dst, res]) & (src_after >= lower[src, res])
     ok_fallback = dst_after / cap[dst] <= src_before / cap[src]
     no_load = jnp.abs(eff.delta_dst[:, res]) <= 0.0
-    return low | no_load | jnp.where(within_before, ok_within, ok_fallback)
+    ok_fwd = low | no_load | jnp.where(within_before, ok_within, ok_fallback)
+
+    # swap direction: the source can GAIN load in this resource (the partner is
+    # only light in the swap round's own resource) — apply the same rule with
+    # the endpoint roles swapped
+    src_gains = eff.delta_src[:, res] > 0.0
+    within_before_b = (dst_before >= lower[dst, res]) & (src_before <= upper[src, res])
+    ok_within_b = (src_after <= upper[src, res]) & (dst_after >= lower[dst, res])
+    ok_fallback_b = src_after / cap[src] <= dst_before / cap[dst]
+    ok_bwd = ~src_gains | low | jnp.where(within_before_b, ok_within_b, ok_fallback_b)
+    return ok_fwd & ok_bwd
 
 
 def accept_leader_count_dist(state, ctx, snap, moves, eff):
-    """LeaderReplicaDistributionGoal: destination leader count stays in band or
-    below the source's pre-move count."""
+    """LeaderReplicaDistributionGoal: whichever endpoint gains leaders stays in
+    band or below the other endpoint's pre-move count (swaps can gain at the
+    source when the partner replica leads)."""
     upper = snap.leader_band[1]
     dst_after = snap.leader_counts[eff.dst_broker] + eff.leader_delta_dst
     src_before = snap.leader_counts[eff.src_broker]
-    return (eff.leader_delta_dst <= 0) | (dst_after <= upper) | (dst_after <= src_before - 1)
+    ok_dst = (
+        (eff.leader_delta_dst <= 0) | (dst_after <= upper) | (dst_after <= src_before - 1)
+    )
+    src_after = snap.leader_counts[eff.src_broker] + eff.leader_delta_src
+    dst_before = snap.leader_counts[eff.dst_broker]
+    ok_src = (
+        (eff.leader_delta_src <= 0) | (src_after <= upper) | (src_after <= dst_before - 1)
+    )
+    return ok_dst & ok_src
 
 
 def accept_topic_replica_dist(state, ctx, snap, moves, eff):
@@ -151,14 +183,51 @@ def accept_topic_replica_dist(state, ctx, snap, moves, eff):
 
 
 def accept_leader_bytes_in(state, ctx, snap, moves, eff):
-    """LeaderBytesInDistributionGoal (:50): destination leader-bytes-in stays under
-    the upper band or under the source's pre-move value."""
+    """LeaderBytesInDistributionGoal (:50): the endpoint gaining leader
+    bytes-in stays under the upper band or under the other endpoint's pre-move
+    value (the source gains when a swap's partner replica leads)."""
     after = snap.leader_nw_in[eff.dst_broker] + eff.lbi_delta_dst
-    return (
+    ok_dst = (
         (eff.lbi_delta_dst <= 0.0)
         | (after <= snap.leader_nw_in_upper)
         | (after <= snap.leader_nw_in[eff.src_broker])
     )
+    src_delta = -eff.lbi_delta_dst
+    src_after = snap.leader_nw_in[eff.src_broker] + src_delta
+    ok_src = (
+        (src_delta <= 0.0)
+        | (src_after <= snap.leader_nw_in_upper)
+        | (src_after <= snap.leader_nw_in[eff.dst_broker])
+    )
+    return ok_dst & ok_src
+
+
+def accept_intra_disk_capacity(state, ctx, snap, moves, eff):
+    """IntraBrokerDiskCapacityGoal: an intra-broker logdir move must land under
+    the destination disk's capacity threshold.  Inter-broker moves and swaps
+    reset the logdir assignment (chosen by the destination broker on arrival),
+    and leadership moves touch no disk — all accepted."""
+    if moves.dst_disk is None or state.num_disks == 0:
+        return jnp.ones(moves.num_slots, bool)
+    r = jnp.where(eff.valid, moves.replica, 0)
+    use = state.base_load[r, Resource.DISK]
+    dd = jnp.where(moves.dst_disk >= 0, moves.dst_disk, 0)
+    after = snap.disk_load[dd] + use
+    return (after <= snap.disk_limits[dd]) & snap.disk_usable[dd] | ~eff.valid
+
+
+def accept_intra_disk_dist(state, ctx, snap, moves, eff):
+    """IntraBrokerDiskUsageDistributionGoal: destination disk stays within its
+    broker's balance band, or at least below the source disk's pre-move load."""
+    if moves.dst_disk is None or state.num_disks == 0:
+        return jnp.ones(moves.num_slots, bool)
+    r = jnp.where(eff.valid, moves.replica, 0)
+    use = state.base_load[r, Resource.DISK]
+    dd = jnp.where(moves.dst_disk >= 0, moves.dst_disk, 0)
+    sd = jnp.where(state.replica_disk[r] >= 0, state.replica_disk[r], 0)
+    after = snap.disk_load[dd] + use
+    ok = (after <= snap.disk_upper[dd]) | (after <= snap.disk_load[sd])
+    return ok | ~eff.valid
 
 
 _KERNELS = {
@@ -170,6 +239,8 @@ _KERNELS = {
     G.TOPIC_REPLICA_DIST: accept_topic_replica_dist,
     G.LEADER_REPLICA_DIST: accept_leader_count_dist,
     G.LEADER_BYTES_IN_DIST: accept_leader_bytes_in,
+    G.INTRA_DISK_CAPACITY: accept_intra_disk_capacity,
+    G.INTRA_DISK_USAGE_DIST: accept_intra_disk_dist,
 }
 
 
@@ -454,13 +525,15 @@ def swap_dst_matrix(
     # Replica counts never change in a swap: ReplicaCapacityGoal,
     # ReplicaDistributionGoal, TopicReplicaDistributionGoal accept by construction.
 
-    # Capacity goals — net load at the destination (source only sheds when gain>0,
-    # which the proposer's gain_fn enforces per goal)
+    # Capacity goals — net load at BOTH endpoints (the source gains whenever
+    # the partner is heavier in a resource the swap round doesn't optimize)
     for gid, res in G.CAPACITY_RESOURCE.items():
-        net = e_out[:, None, res] - e_in[None, :, res]
+        net = e_out[:, None, res] - e_in[None, :, res]      # dst gains this
         after = snap.broker_load[None, :, res] + net
         fits = (after <= snap.cap_limits[None, :, res]) | (net <= 0.0)
-        ok &= jnp.where(prior_mask[gid], fits, True)
+        src_after = snap.broker_load[src, res][:, None] - net
+        src_fits = (src_after <= snap.cap_limits[src, res][:, None]) | (net >= 0.0)
+        ok &= jnp.where(prior_mask[gid], fits & src_fits, True)
 
     # ResourceDistributionGoals — net deltas at both endpoints
     for gid, res in G.DIST_RESOURCE.items():

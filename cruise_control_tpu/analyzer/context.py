@@ -117,6 +117,14 @@ class Snapshot:
     leader_nw_in: jax.Array    # f32[B] bytes-in of leader replicas per broker
     leader_nw_in_upper: jax.Array  # f32 scalar upper band for leader bytes-in
 
+    # JBOD disk-axis tensors (zero-length when the cluster has no logdirs)
+    disk_load: jax.Array = None        # f32[D] disk-space use per logdir
+    disk_limits: jax.Array = None      # f32[D] capacity_threshold · disk capacity
+    disk_lower: jax.Array = None       # f32[D] intra-broker balance band lower
+    disk_upper: jax.Array = None       # f32[D] intra-broker balance band upper
+    disk_usable: jax.Array = None      # bool[D] alive and not marked for removal
+    disk_replica_counts: jax.Array = None  # i32[D] replicas assigned per logdir
+
     # heavy [B, T] tensors — None unless enable_heavy
     topic_counts: Optional[jax.Array] = None       # i32[B, T]
     topic_band: Optional[jax.Array] = None         # i32[2, T] (lower, upper)
@@ -179,6 +187,37 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
     bpm = c.balance_percentage_with_margin(ctx.triggered_by_violation)
     lbi_upper = lbi_avg * (1.0 + bpm[Resource.NW_IN])
 
+    # JBOD disk tensors (IntraBrokerDisk* goals; D == 0 ⇒ zero-size, no cost)
+    dload = A.disk_load(state)
+    d_usable = state.disk_alive & (state.disk_capacity > 0.0)
+    d_limit = c.resource_capacity_threshold[Resource.DISK] * state.disk_capacity
+    on_disk = state.replica_disk >= 0
+    d_counts = jax.ops.segment_sum(
+        (on_disk & state.replica_valid).astype(jnp.int32),
+        jnp.where(on_disk, state.replica_disk, state.num_disks),
+        num_segments=max(state.num_disks, 1),
+    )[: state.num_disks]
+    if state.num_disks > 0:
+        # band around each broker's mean usable-disk utilization
+        # (IntraBrokerDiskUsageDistributionGoal balances a broker's own disks)
+        per_b_load = jax.ops.segment_sum(
+            jnp.where(d_usable, dload, 0.0), state.disk_broker,
+            num_segments=state.num_brokers,
+        )
+        per_b_cap = jax.ops.segment_sum(
+            jnp.where(d_usable, state.disk_capacity, 0.0), state.disk_broker,
+            num_segments=state.num_brokers,
+        )
+        avg_d_pct = per_b_load / jnp.maximum(per_b_cap, 1e-9)
+        bpm_d = c.balance_percentage_with_margin(ctx.triggered_by_violation)[Resource.DISK]
+        d_lower = jnp.maximum(0.0, avg_d_pct[state.disk_broker] * (1.0 - bpm_d)) * state.disk_capacity
+        d_upper = avg_d_pct[state.disk_broker] * (1.0 + bpm_d) * state.disk_capacity
+        d_lower = jnp.where(d_usable, d_lower, 0.0)
+        d_upper = jnp.where(d_usable, d_upper, 0.0)
+    else:
+        d_lower = jnp.zeros((0,), jnp.float32)
+        d_upper = jnp.zeros((0,), jnp.float32)
+
     topic_counts = topic_band = topic_leader_counts = None
     if enable_heavy:
         topic_counts = A.topic_replica_counts_by_broker(state)
@@ -220,6 +259,12 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         leader_band=jnp.stack([l_lo, l_up]),
         leader_nw_in=lbi,
         leader_nw_in_upper=lbi_upper,
+        disk_load=dload,
+        disk_limits=d_limit,
+        disk_lower=d_lower,
+        disk_upper=d_upper,
+        disk_usable=d_usable,
+        disk_replica_counts=d_counts,
         topic_counts=topic_counts,
         topic_band=topic_band,
         topic_leader_counts=topic_leader_counts,

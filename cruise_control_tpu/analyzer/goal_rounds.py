@@ -25,6 +25,7 @@ from cruise_control_tpu.analyzer.context import GoalContext, Snapshot
 from cruise_control_tpu.analyzer.moves import MoveBatch
 from cruise_control_tpu.analyzer.proposers import (
     fill_round,
+    intra_disk_round,
     leadership_fill_round,
     leadership_shed_round,
     shed_round,
@@ -365,16 +366,24 @@ def _dist_fill_round(res: int) -> RoundFn:
     return fn
 
 
-def _dist_swap_round(res: int) -> RoundFn:
-    """Pairwise swap fallback for usage-distribution goals
-    (ResourceDistributionGoal.rebalanceBySwappingLoadOut, :599): runs after the
-    move rounds converge; sheds net load from still-over-upper brokers by trading
-    a heavy replica for a light one, keeping replica counts intact."""
+def _swap_shed_round(res: int, capacity_bound: bool) -> RoundFn:
+    """Pairwise swap fallback: trade a heavy replica for a light one when plain
+    moves stall (every destination vetoed or full).
+
+    ``capacity_bound=False`` mirrors ``ResourceDistributionGoal.rebalanceBy-
+    SwappingLoadOut`` (:599) against the balance band's upper edge;
+    ``capacity_bound=True`` applies the same mechanics against the capacity
+    limit — a TPU-side extension (the reference's CapacityGoal only moves),
+    which unsticks tight clusters whose rack-eligible destinations are full."""
 
     def fn(state, ctx, snap, prior_mask, salt):
-        upper = snap.res_upper[:, res]
-        low = snap.low_util[res]
-        src_need = jnp.where(low, 0.0, snap.broker_load[:, res] - upper)
+        if capacity_bound:
+            bound = snap.cap_limits[:, res]
+            src_need = snap.broker_load[:, res] - bound
+        else:
+            bound = snap.res_upper[:, res]
+            low = snap.low_util[res]
+            src_need = jnp.where(low, 0.0, snap.broker_load[:, res] - bound)
         load = snap.eff_load[:, res]
 
         def gain_fn(r_out, partner):
@@ -382,7 +391,7 @@ def _dist_swap_round(res: int) -> RoundFn:
             e_in = load[partner][None, :]
             gain = e_out - e_in                       # net load shed from the source
             dst_after = snap.broker_load[None, :, res] + gain
-            ok = (gain > 0.0) & (dst_after <= upper[None, :])
+            ok = (gain > 0.0) & (dst_after <= bound[None, :])
             return ok, gain
 
         return swap_round(
@@ -396,39 +405,14 @@ def _dist_swap_round(res: int) -> RoundFn:
         )
 
     return fn
+
+
+def _dist_swap_round(res: int) -> RoundFn:
+    return _swap_shed_round(res, capacity_bound=False)
 
 
 def _capacity_swap_round(res: int) -> RoundFn:
-    """Pairwise swap fallback for capacity goals: when no destination can absorb a
-    whole replica (rack-constrained destinations full — common in tight clusters),
-    trade a heavy replica for a light one.  The reference's CapacityGoal only
-    moves; the swap fallback is a TPU-side extension reusing the
-    ResourceDistributionGoal swap semantics against the capacity limit."""
-
-    def fn(state, ctx, snap, prior_mask, salt):
-        limit = snap.cap_limits[:, res]
-        src_need = snap.broker_load[:, res] - limit
-        load = snap.eff_load[:, res]
-
-        def gain_fn(r_out, partner):
-            e_out = load[r_out][:, None]
-            e_in = load[partner][None, :]
-            gain = e_out - e_in
-            dst_after = snap.broker_load[None, :, res] + gain
-            ok = (gain > 0.0) & (dst_after <= limit[None, :])
-            return ok, gain
-
-        return swap_round(
-            state, ctx, snap, prior_mask, salt,
-            src_need=src_need,
-            out_score=load,
-            out_ok=snap.movable & (load > 0),
-            in_score=-load,
-            in_ok=snap.movable,
-            gain_fn=gain_fn,
-        )
-
-    return fn
+    return _swap_shed_round(res, capacity_bound=True)
 
 
 # -- TopicReplicaDistributionGoal --------------------------------------------------
@@ -550,6 +534,73 @@ def min_topic_leaders_round(
     )
 
 
+# -- JBOD intra-broker goals (IntraBrokerDiskCapacityGoal.java,
+#    IntraBrokerDiskUsageDistributionGoal.java) ------------------------------------
+
+
+def intra_disk_capacity_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
+    """Drain overfull and non-usable (removed/dead) logdirs to sibling disks of
+    the same broker.  The REMOVE_DISKS flow marks logdirs non-usable, then runs
+    this goal (RemoveDisksRunnable semantics)."""
+    over = snap.disk_load - snap.disk_limits
+    # non-usable disks must drain COMPLETELY — need counts replicas, not load,
+    # so zero-size replicas drain too
+    src_need = jnp.where(
+        snap.disk_usable,
+        jnp.maximum(over, 0.0),
+        snap.disk_replica_counts.astype(jnp.float32),
+    )
+    du = state.base_load[:, Resource.DISK]
+    on_dead_disk = (state.replica_disk >= 0) & ~snap.disk_usable[
+        jnp.maximum(state.replica_disk, 0)
+    ]
+
+    def dst_fn(cand: jax.Array):
+        fits = snap.disk_load[None, :] + du[cand][:, None] <= snap.disk_limits[None, :]
+        cap = jnp.maximum(state.disk_capacity, 1e-9)
+        score = _bcast(-(snap.disk_load / cap), cand.shape[0])
+        return fits, score
+
+    return intra_disk_round(
+        state, ctx, snap, prior_mask, salt,
+        src_need=src_need,
+        cand_score=du,
+        cand_ok=snap.movable & ((du > 0) | on_dead_disk),
+        dst_fn=dst_fn,
+    )
+
+
+def intra_disk_dist_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
+    """Balance disk usage across each broker's own logdirs: shed from disks over
+    their broker-relative band toward under-loaded siblings."""
+    src_need = jnp.where(snap.disk_usable, snap.disk_load - snap.disk_upper, 0.0)
+    du = state.base_load[:, Resource.DISK]
+    on_disk = state.replica_disk >= 0
+    sd = jnp.where(on_disk, state.replica_disk, 0)
+    keeps_src = du <= snap.disk_load[sd] - snap.disk_lower[sd]
+
+    def dst_fn(cand: jax.Array):
+        after = snap.disk_load[None, :] + du[cand][:, None]
+        fits = after <= snap.disk_upper[None, :]
+        cap = jnp.maximum(state.disk_capacity, 1e-9)
+        score = _bcast(-(snap.disk_load / cap), cand.shape[0])
+        return fits, score
+
+    return intra_disk_round(
+        state, ctx, snap, prior_mask, salt,
+        src_need=src_need,
+        cand_score=du,
+        cand_ok=snap.movable & (du > 0) & keeps_src,
+        dst_fn=dst_fn,
+    )
+
+
 # -- registry ----------------------------------------------------------------------
 
 GOAL_ROUNDS: Dict[int, Tuple[RoundFn, ...]] = {
@@ -601,4 +652,6 @@ GOAL_ROUNDS: Dict[int, Tuple[RoundFn, ...]] = {
     G.TOPIC_REPLICA_DIST: (topic_dist_round,),
     G.LEADER_REPLICA_DIST: (leader_dist_shed, leader_dist_fill),
     G.LEADER_BYTES_IN_DIST: (leader_bytes_in_round,),
+    G.INTRA_DISK_CAPACITY: (intra_disk_capacity_round,),
+    G.INTRA_DISK_USAGE_DIST: (intra_disk_dist_round,),
 }

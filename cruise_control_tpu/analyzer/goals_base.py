@@ -44,7 +44,11 @@ CPU_USAGE_DIST = 12
 TOPIC_REPLICA_DIST = 13
 LEADER_REPLICA_DIST = 14
 LEADER_BYTES_IN_DIST = 15
-NUM_GOALS = 16
+# JBOD intra-broker goals (optional — not in the default list, used by
+# REMOVE_DISKS and explicit goal lists, IntraBrokerDiskCapacityGoal.java)
+INTRA_DISK_CAPACITY = 16
+INTRA_DISK_USAGE_DIST = 17
+NUM_GOALS = 18
 
 GOAL_NAMES: Tuple[str, ...] = (
     "RackAwareGoal",
@@ -63,6 +67,8 @@ GOAL_NAMES: Tuple[str, ...] = (
     "TopicReplicaDistributionGoal",
     "LeaderReplicaDistributionGoal",
     "LeaderBytesInDistributionGoal",
+    "IntraBrokerDiskCapacityGoal",
+    "IntraBrokerDiskUsageDistributionGoal",
 )
 GOAL_ID_BY_NAME: Dict[str, int] = {n: i for i, n in enumerate(GOAL_NAMES)}
 
@@ -80,8 +86,13 @@ HARD_GOALS: Tuple[int, ...] = (
     CPU_CAPACITY,
 )
 
-#: Default goal priority order (AnalyzerConfig.java:352-368, DEFAULT_DEFAULT_GOALS).
-DEFAULT_GOAL_ORDER: Tuple[int, ...] = tuple(range(NUM_GOALS))
+#: Default goal priority order (AnalyzerConfig.java:352-368, DEFAULT_DEFAULT_GOALS)
+#: — the 16 inter-broker goals; intra-broker (JBOD) goals are opt-in.
+DEFAULT_GOAL_ORDER: Tuple[int, ...] = tuple(range(16))
+
+#: Goal list used by the REMOVE_DISKS flow (RemoveDisksRunnable — drain marked
+#: logdirs to their broker's remaining disks, then balance across them).
+INTRA_BROKER_GOALS: Tuple[int, ...] = (INTRA_DISK_CAPACITY, INTRA_DISK_USAGE_DIST)
 
 CAPACITY_RESOURCE: Dict[int, int] = {
     DISK_CAPACITY: Resource.DISK,
@@ -182,5 +193,19 @@ def violations_all(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> ja
         deficit = jnp.maximum(0, need - snap.topic_leader_counts) * ctx.min_leader_topics[None, :]
         deficit = jnp.where(alive[:, None], deficit, 0)
         out = out.at[MIN_TOPIC_LEADERS].set(deficit.sum())
+
+    if state.num_disks > 0:
+        usable = snap.disk_usable
+        d_over = (snap.disk_load > snap.disk_limits * (1 + eps) + eps) & usable
+        # ANY replica sitting on a dead/removed logdir violates the goal —
+        # counted by replica count, not load (empty replicas must drain too)
+        stranded = snap.disk_replica_counts > 0
+        d_over = d_over | (stranded & ~usable)
+        out = out.at[INTRA_DISK_CAPACITY].set(d_over.sum())
+        d_out = (
+            (snap.disk_load > snap.disk_upper * (1 + eps) + eps)
+            | (snap.disk_load < snap.disk_lower * (1 - eps) - eps)
+        ) & usable
+        out = out.at[INTRA_DISK_USAGE_DIST].set(d_out.sum())
 
     return out

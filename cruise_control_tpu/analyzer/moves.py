@@ -36,10 +36,11 @@ from flax import struct
 from cruise_control_tpu.model import arrays as A
 from cruise_control_tpu.model.arrays import ClusterArrays
 
-# ActionType (ActionType.java:23-28). Intra-broker variants arrive with JBOD goals.
+# ActionType (ActionType.java:23-28).
 KIND_REPLICA_MOVE = 0
 KIND_LEADERSHIP = 1
 KIND_SWAP = 2
+KIND_INTRA_MOVE = 3   # INTRA_BROKER_REPLICA_MOVEMENT: logdir change, same broker
 
 
 @struct.dataclass
@@ -51,6 +52,8 @@ class MoveBatch:
     dst_broker: jax.Array   # i32[K] destination broker
     dst_replica: jax.Array  # i32[K] swap partner / new leader replica; -1 otherwise
     score: jax.Array        # f32[K] admission priority (higher admits first)
+    #: i32[K] destination logdir for KIND_INTRA_MOVE batches; None otherwise
+    dst_disk: "jax.Array | None" = None
 
     @property
     def num_slots(self) -> int:
@@ -111,6 +114,7 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
     kind = moves.kind
     is_move = kind == KIND_REPLICA_MOVE
     is_lead = kind == KIND_LEADERSHIP
+    is_intra = kind == KIND_INTRA_MOVE
 
     rb = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
     ldelta = state.leadership_delta[p]
@@ -124,6 +128,9 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
 
     delta_src = jnp.where(is_move, move_src, jnp.where(is_lead, lead_src, swap_src))
     delta_dst = jnp.where(is_move, move_dst, jnp.where(is_lead, lead_dst, swap_dst))
+    # intra-broker logdir moves change no broker-level quantity at all
+    delta_src = jnp.where(is_intra, 0.0, delta_src)
+    delta_dst = jnp.where(is_intra, 0.0, delta_dst)
 
     lead = A.is_leader(state)
     r_leads = lead[r]
@@ -134,6 +141,7 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
         -r_leads.astype(jnp.int32),
         jnp.where(is_lead, -1, rb_leads.astype(jnp.int32) - r_leads.astype(jnp.int32)),
     )
+    lsrc = jnp.where(is_intra, 0, lsrc)
     ldst = -lsrc
     cnt = jnp.where(is_move, 1, 0)
 
@@ -147,6 +155,7 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
         + state.leadership_delta[state.replica_partition[rb], Resource.NW_OUT]
     )
     pnw = jnp.where(is_move, leader_nw, jnp.where(is_lead, 0.0, leader_nw - partner_nw))
+    pnw = jnp.where(is_intra, 0.0, pnw)
 
     # Leader bytes-in (LeaderBytesInDistributionGoal): NW_IN attributed to the
     # leader replica follows the leadership.
@@ -155,6 +164,7 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
     lbi_move = jnp.where(r_leads, nw_in_r, 0.0)
     lbi_swap = jnp.where(r_leads, nw_in_r, 0.0) - jnp.where(rb_leads, nw_in_rb, 0.0)
     lbi = jnp.where(is_move, lbi_move, jnp.where(is_lead, nw_in_r, lbi_swap))
+    lbi = jnp.where(is_intra, 0.0, lbi)
 
     z = jnp.int32(0)
     return MoveEffects(
@@ -290,6 +300,16 @@ def admit(
     # exactly one action per partition per round (partition-level invariants)
     keep = _keep_best_per_key(keep, eff.partition, moves.score, state.num_partitions)
 
+    if moves.dst_disk is not None:
+        # intra-broker logdir moves: no broker-level deltas; serialize per
+        # destination and source disk so per-disk threshold checks against the
+        # pre-round snapshot stay valid after the batch applies
+        dd = jnp.where(keep, moves.dst_disk, 0)
+        keep = _keep_best_per_key(keep, dd, moves.score, max(state.num_disks, 1))
+        src_disk = state.replica_disk[jnp.where(keep, moves.replica, 0)]
+        sd = jnp.where(keep & (src_disk >= 0), src_disk, 0)
+        return _keep_best_per_key(keep, sd, moves.score, max(state.num_disks, 1))
+
     is_swap = moves.kind == KIND_SWAP
 
     def _swap_admit(keep):
@@ -342,6 +362,9 @@ def resolve_conflicts(
 def apply_moves(state: ClusterArrays, moves: MoveBatch, keep: jax.Array) -> ClusterArrays:
     """Apply the surviving slots as batched scatters (fixed shape, jit-safe)."""
     sel = jnp.where(keep, moves.replica, -1)
+
+    if moves.dst_disk is not None:
+        return A.relocate_replica_disks(state, sel, moves.dst_disk)
 
     def _apply_replica_move(state):
         return A.relocate_replicas(state, sel, moves.dst_broker)

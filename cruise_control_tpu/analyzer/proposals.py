@@ -127,3 +127,31 @@ def diff(
             )
         )
     return proposals
+
+
+def logdir_moves(
+    initial: ClusterArrays, final: ClusterArrays, maps: IndexMaps
+) -> Dict[Tuple[TopicPartition, int], str]:
+    """Intra-broker logdir changes between two snapshots.
+
+    {(topic-partition, broker_id) -> destination logdir} for every replica whose
+    broker is unchanged but whose disk assignment moved — the executor feeds these
+    to ``alter_replica_logdirs`` in its intra-broker phase
+    (Executor.intraBrokerMoveReplicas, Executor.java:1679).
+    """
+    out: Dict[Tuple[TopicPartition, int], str] = {}
+    if initial.num_disks == 0:
+        return out
+    rb0 = np.asarray(initial.replica_broker)
+    rb1 = np.asarray(final.replica_broker)
+    rd0 = np.asarray(initial.replica_disk)
+    rd1 = np.asarray(final.replica_disk)
+    rp = np.asarray(final.replica_partition)
+    valid = np.asarray(final.replica_valid)
+    changed = valid & (rb0 == rb1) & (rd0 != rd1) & (rd1 >= 0)
+    for row in np.nonzero(changed)[0]:
+        tp = maps.partitions[int(rp[row])]
+        broker_id = maps.broker_ids[int(rb1[row])]
+        _, logdir = maps.disks[int(rd1[row])]
+        out[(tp, broker_id)] = logdir
+    return out

@@ -35,6 +35,7 @@ from cruise_control_tpu.analyzer.acceptance import (
 )
 from cruise_control_tpu.analyzer.context import NEG, GoalContext, Snapshot, segment_argmax
 from cruise_control_tpu.analyzer.moves import (
+    KIND_INTRA_MOVE,
     KIND_LEADERSHIP,
     KIND_REPLICA_MOVE,
     KIND_SWAP,
@@ -417,4 +418,58 @@ def swap_round(
         dst_broker=jnp.where(replica >= 0, dst, -1),
         dst_replica=jnp.where(replica >= 0, partner[dst_safe], -1),
         score=jnp.where(replica >= 0, src_need[src_of_slot], 0.0),
+    )
+
+
+def intra_disk_round(
+    state: ClusterArrays,
+    ctx: GoalContext,
+    snap: Snapshot,
+    prior_mask: jax.Array,
+    salt: jax.Array,
+    src_need: jax.Array,     # f32[D] > 0 ⇒ logdir must shed
+    cand_score: jax.Array,   # f32[R] preference among the disk's replicas
+    cand_ok: jax.Array,      # bool[R]
+    dst_fn: DstFn,           # dst_fn(cand i32[S]) -> (elig bool[S, D], score f32[S, D])
+) -> MoveBatch:
+    """One intra-broker logdir-move round (IntraBrokerDisk* goals).
+
+    Sources and destinations are *disks*; every move stays on the replica's
+    broker (Executor.intraBrokerMoveReplicas / alterReplicaLogDirs,
+    Executor.java:1679).  Inter-broker goals are unaffected (zero broker-level
+    deltas), so no prior-goal destination matrix is needed — eligibility is the
+    goal's own dst_fn plus same-broker/usable-disk masks.
+    """
+    D = state.num_disks
+    k = ctx.top_k
+    S = k * D
+    on_disk = state.replica_disk >= 0
+    seg = jnp.where(on_disk, state.replica_disk, D)
+    active = src_need > 0
+    cands = topk_segment_argmax(cand_score, seg, D, cand_ok & on_disk, k)
+    cand = cands.reshape(-1)
+    src_disk_of_slot = jnp.tile(jnp.arange(D, dtype=jnp.int32), k)
+    valid = active[src_disk_of_slot] & (cand >= 0)
+    cand_safe = jnp.where(cand >= 0, cand, 0)
+
+    elig, score = dst_fn(cand_safe)
+    cols = jnp.arange(D, dtype=jnp.int32)
+    same_broker = (
+        state.disk_broker[None, :] == state.replica_broker[cand_safe][:, None]
+    )
+    not_self = cols[None, :] != src_disk_of_slot[:, None]
+    elig = elig & same_broker & not_self & snap.disk_usable[None, :] & valid[:, None]
+    score = score + _pair_jitter(cand_safe[:, None], cols[None, :], salt)
+    score = jnp.where(elig, score, NEG)
+    dst = jnp.argmax(score, axis=1).astype(jnp.int32)
+    found = jnp.take_along_axis(score, dst[:, None], axis=1)[:, 0] > NEG / 2
+
+    replica = jnp.where(valid & found, cand_safe, -1)
+    return MoveBatch(
+        kind=jnp.asarray(KIND_INTRA_MOVE, jnp.int32),
+        replica=replica,
+        dst_broker=jnp.where(replica >= 0, state.replica_broker[cand_safe], -1),
+        dst_replica=jnp.full(S, -1, jnp.int32),
+        score=jnp.where(replica >= 0, src_need[src_disk_of_slot], 0.0),
+        dst_disk=jnp.where(replica >= 0, dst, -1),
     )

@@ -1,0 +1,127 @@
+"""Additional security providers: JWT bearer tokens and trusted proxies.
+
+Counterparts of the reference's pluggable security stacks
+(``servlet/security/jwt/`` — JwtLoginService/JwtAuthenticator — and
+``servlet/security/trustedproxy/`` — TrustedProxyLoginService); SPNEGO/Kerberos
+is out of scope for a stdlib-only build (its role — verified identity from an
+external authority — is covered by the JWT provider).
+
+* :class:`JwtSecurityProvider` verifies ``Authorization: Bearer <jwt>`` tokens
+  signed with HS256 (stdlib hmac), checks ``exp`` and optional ``aud``, and maps
+  a claim (default ``"role"``) onto the ADMIN/USER/VIEWER model.
+* :class:`TrustedProxySecurityProvider` authenticates a fronting proxy by a
+  shared secret header, then trusts the end-user identity the proxy forwards
+  (``doAs`` semantics), with a per-user role table.
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import hashlib
+import json
+import time
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+from cruise_control_tpu.api.security import AuthenticationError, Role, SecurityProvider
+
+
+def _b64url_decode(segment: str) -> bytes:
+    pad = "=" * (-len(segment) % 4)
+    return base64.urlsafe_b64decode(segment + pad)
+
+
+def _b64url_encode(raw: bytes) -> str:
+    return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+
+def encode_jwt(claims: Mapping[str, object], secret: str) -> str:
+    """Mint an HS256 JWT (test/tooling helper; the provider only verifies)."""
+    header = _b64url_encode(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64url_encode(json.dumps(dict(claims)).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = hmac.new(secret.encode(), signing_input, hashlib.sha256).digest()
+    return f"{header}.{payload}.{_b64url_encode(sig)}"
+
+
+class JwtSecurityProvider(SecurityProvider):
+    """``Authorization: Bearer`` HS256 validation (servlet/security/jwt/)."""
+
+    def __init__(
+        self,
+        secret: str,
+        expected_audiences: Optional[Sequence[str]] = None,
+        role_claim: str = "role",
+        subject_claim: str = "sub",
+        now: Optional[callable] = None,
+    ) -> None:
+        self.secret = secret
+        self.expected_audiences = set(expected_audiences or [])
+        self.role_claim = role_claim
+        self.subject_claim = subject_claim
+        self._now = now or time.time
+
+    def authenticate(self, headers: Mapping[str, str]) -> Tuple[Optional[str], Role]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            raise AuthenticationError("missing bearer token")
+        token = auth[7:].strip()
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise AuthenticationError("malformed token")
+        header_s, payload_s, sig_s = parts
+        try:
+            header = json.loads(_b64url_decode(header_s))
+            payload = json.loads(_b64url_decode(payload_s))
+            signature = _b64url_decode(sig_s)
+        except Exception as e:
+            raise AuthenticationError("undecodable token") from e
+        if header.get("alg") != "HS256":
+            raise AuthenticationError(f"unsupported alg {header.get('alg')!r}")
+        signing_input = f"{header_s}.{payload_s}".encode()
+        expected = hmac.new(self.secret.encode(), signing_input, hashlib.sha256).digest()
+        if not hmac.compare_digest(expected, signature):
+            raise AuthenticationError("bad signature")
+        exp = payload.get("exp")
+        if exp is not None and float(exp) < self._now():
+            raise AuthenticationError("token expired")
+        if self.expected_audiences:
+            aud = payload.get("aud")
+            auds = set(aud) if isinstance(aud, list) else {aud}
+            if not (auds & self.expected_audiences):
+                raise AuthenticationError("audience mismatch")
+        user = payload.get(self.subject_claim)
+        role_name = str(payload.get(self.role_claim, "USER")).upper()
+        try:
+            role = Role[role_name]
+        except KeyError:
+            raise AuthenticationError(f"unknown role {role_name!r}") from None
+        return user, role
+
+
+class TrustedProxySecurityProvider(SecurityProvider):
+    """Authenticate the proxy, trust its forwarded end-user identity
+    (servlet/security/trustedproxy/ semantics with a shared-secret handshake)."""
+
+    def __init__(
+        self,
+        proxy_secret: str,
+        user_roles: Optional[Dict[str, Role]] = None,
+        default_role: Role = Role.USER,
+        secret_header: str = "X-Proxy-Secret",
+        user_header: str = "X-Forwarded-User",
+    ) -> None:
+        self.proxy_secret = proxy_secret
+        self.user_roles = user_roles or {}
+        self.default_role = default_role
+        self.secret_header = secret_header
+        self.user_header = user_header
+
+    def authenticate(self, headers: Mapping[str, str]) -> Tuple[Optional[str], Role]:
+        supplied = headers.get(self.secret_header, "")
+        if not hmac.compare_digest(self.proxy_secret.encode(), supplied.encode()):
+            raise AuthenticationError("untrusted proxy")
+        user = headers.get(self.user_header)
+        if not user:
+            raise AuthenticationError("proxy forwarded no user")
+        return user, self.user_roles.get(user, self.default_role)

@@ -382,13 +382,7 @@ class CruiseControlApp:
         dryrun = _qbool(params, "dryrun", True)
 
         def work(progress):
-            model = self.cc.cluster_model()
-            for b, logdir in pairs:
-                try:
-                    model.mark_disk_dead(b, logdir)
-                except ValueError:
-                    pass
-            return self.cc._optimize_and_maybe_execute(model, dryrun)
+            return self.cc.remove_disks(pairs, dryrun=dryrun)
 
         return self._async_op("REMOVE_DISKS", params, work)
 

@@ -1,0 +1,315 @@
+"""Application shell: assemble the whole system from a properties file.
+
+Counterpart of ``KafkaCruiseControlMain.main`` (KafkaCruiseControlMain.java:26-40)
+→ ``KafkaCruiseControlApp`` (KafkaCruiseControlApp.java:16): read + validate the
+config, build backend → monitor → optimizer/facade → executor → detectors →
+REST server, start the sampling loop and detection schedules, serve HTTP.
+
+The southbound boundary is the :class:`ClusterBackend` SPI instead of a Kafka
+AdminClient; the default backend is the in-process fake cluster (the embedded-
+harness equivalent), with real backends pluggable via ``cluster.backend.class``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Mapping, Optional
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.api.security import BasicSecurityProvider, SecurityProvider
+from cruise_control_tpu.api.server import CruiseControlApp, make_server
+from cruise_control_tpu.backend.base import ClusterBackend
+from cruise_control_tpu.core.config import Config, ConfigException, resolve_class
+from cruise_control_tpu.core.config_defs import cruise_control_config
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.detector.detectors import (
+    BrokerFailureDetector,
+    DiskFailureDetector,
+    GoalViolationDetector,
+    SlowBrokerFinder,
+    TopicReplicationFactorAnomalyFinder,
+)
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+from cruise_control_tpu.detector.notifier import AnomalyNotifier
+from cruise_control_tpu.detector.provisioner import Provisioner
+from cruise_control_tpu.executor import Executor
+from cruise_control_tpu.executor.concurrency import ConcurrencyConfig
+from cruise_control_tpu.executor.engine import ExecutorNotifier
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor import LoadMonitor
+from cruise_control_tpu.monitor.capacity import (
+    BrokerCapacityResolver,
+    FileCapacityResolver,
+    StaticCapacityResolver,
+)
+from cruise_control_tpu.monitor.samples import MetricSampler
+from cruise_control_tpu.monitor.samplestore import SampleStore
+
+
+def _goal_ids(names, default):
+    names = [n for n in (names or []) if n]
+    if not names:
+        return default
+    try:
+        return tuple(G.GOAL_ID_BY_NAME[n] for n in names)
+    except KeyError as e:
+        raise ConfigException(f"Unknown goal name {e.args[0]!r}") from None
+
+
+def _constraint(cfg: Config) -> BalancingConstraint:
+    res = {
+        "cpu": Resource.CPU,
+        "disk": Resource.DISK,
+        "network.inbound": Resource.NW_IN,
+        "network.outbound": Resource.NW_OUT,
+    }
+    return BalancingConstraint.default(
+        resource_balance_threshold={
+            r: cfg.get(f"{n}.balance.threshold") for n, r in res.items()
+        },
+        resource_capacity_threshold={
+            r: cfg.get(f"{n}.capacity.threshold") for n, r in res.items()
+        },
+        low_utilization_threshold={
+            r: cfg.get(f"{n}.low.utilization.threshold") for n, r in res.items()
+        },
+        replica_balance_threshold=cfg.get("replica.count.balance.threshold"),
+        leader_replica_balance_threshold=cfg.get("leader.replica.count.balance.threshold"),
+        topic_replica_balance_threshold=cfg.get("topic.replica.count.balance.threshold"),
+        max_replicas_per_broker=cfg.get("max.replicas.per.broker"),
+        distribution_threshold_multiplier=cfg.get(
+            "goal.violation.distribution.threshold.multiplier"
+        ),
+        min_topic_leaders_per_broker=cfg.get("min.topic.leaders.per.broker"),
+        topic_replica_balance_min_gap=cfg.get("topic.replica.count.balance.min.gap"),
+        topic_replica_balance_max_gap=cfg.get("topic.replica.count.balance.max.gap"),
+    )
+
+
+def _security(cfg: Config) -> Optional[SecurityProvider]:
+    if not cfg.get("webserver.security.enable"):
+        return None
+    from cruise_control_tpu.api.security import Role
+
+    path = cfg.get("webserver.auth.credentials.file")
+    users = {}
+    if path:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                # Jetty realm format: "user: password, ROLE"
+                user, _, rest = line.partition(":")
+                password, _, role = rest.partition(",")
+                role_name = (role.strip() or "USER").upper()
+                users[user.strip()] = (password.strip(), Role[role_name])
+    return BasicSecurityProvider(users)
+
+
+class CruiseControlTpuApp:
+    """The running service: facade + detectors + HTTP server + sampling loop."""
+
+    def __init__(
+        self,
+        props: Mapping[str, object],
+        backend: Optional[ClusterBackend] = None,
+    ) -> None:
+        cfg = Config(cruise_control_config(), props)
+        self.config = cfg
+
+        if backend is None:
+            spec = props.get("cluster.backend.class")
+            if spec:
+                backend = resolve_class(spec)()
+            else:
+                from cruise_control_tpu.backend import FakeClusterBackend
+
+                backend = FakeClusterBackend()
+        self.backend = backend
+
+        sampler_cls = resolve_class(cfg.get("metric.sampler.class"))
+        try:
+            sampler: MetricSampler = sampler_cls(backend)
+        except TypeError:
+            sampler = sampler_cls()
+        resolver_cls = resolve_class(cfg.get("broker.capacity.config.resolver.class"))
+        if issubclass(resolver_cls, FileCapacityResolver):
+            resolver: BrokerCapacityResolver = resolver_cls(cfg.get("capacity.config.file"))
+        elif issubclass(resolver_cls, StaticCapacityResolver):
+            resolver = resolver_cls({r: 1.0 for r in Resource})
+        else:
+            resolver = resolver_cls()
+        store_cls = resolve_class(cfg.get("sample.store.class"))
+        try:
+            store: SampleStore = store_cls(cfg.get("sample.store.dir"))
+        except TypeError:
+            store = store_cls()
+
+        self.monitor = LoadMonitor(
+            backend,
+            sampler,
+            resolver,
+            num_windows=cfg.get("num.partition.metrics.windows"),
+            window_ms=cfg.get("partition.metrics.window.ms"),
+            min_samples_per_window=cfg.get("min.samples.per.partition.metrics.window"),
+            sample_store=store if not cfg.get("skip.loading.samples") else None,
+        )
+        self.executor = Executor(
+            backend,
+            concurrency=ConcurrencyConfig(
+                per_broker_moves=cfg.get("num.concurrent.partition.movements.per.broker"),
+                cluster_moves=cfg.get("max.num.cluster.partition.movements"),
+                intra_broker_moves=cfg.get("num.concurrent.intra.broker.partition.movements"),
+                leadership_batch=cfg.get("num.concurrent.leader.movements"),
+            ),
+            throttle_rate_bytes=cfg.get("default.replication.throttle"),
+            notifier=cfg.get_configured_instance("executor.notifier.class", ExecutorNotifier),
+            pause_sampling=self.monitor.pause_sampling,
+            resume_sampling=self.monitor.resume_sampling,
+        )
+        self.cruise_control = CruiseControl(
+            backend,
+            self.monitor,
+            self.executor,
+            goal_ids=_goal_ids(cfg.get("default.goals"), G.DEFAULT_GOAL_ORDER),
+            hard_ids=_goal_ids(cfg.get("hard.goals"), G.HARD_GOALS),
+            constraint=_constraint(cfg),
+        )
+
+        interval = cfg.get("anomaly.detection.interval.ms") / 1000.0
+
+        def _iv(key):
+            v = cfg.get(key)
+            return (v / 1000.0) if v is not None else interval
+
+        detectors = [
+            (
+                GoalViolationDetector(
+                    self.cruise_control,
+                    detection_goal_ids=_goal_ids(
+                        cfg.get("anomaly.detection.goals"), G.DEFAULT_GOAL_ORDER
+                    ),
+                ),
+                _iv("goal.violation.detection.interval.ms"),
+            ),
+            (
+                BrokerFailureDetector(backend, cfg.get("failed.brokers.file.path")),
+                _iv("broker.failure.detection.interval.ms"),
+            ),
+            (DiskFailureDetector(backend), _iv("disk.failure.detection.interval.ms")),
+            (SlowBrokerFinder(self.monitor), _iv("metric.anomaly.detection.interval.ms")),
+            (
+                TopicReplicationFactorAnomalyFinder(backend),
+                _iv("topic.anomaly.detection.interval.ms"),
+            ),
+        ]
+        notifier_cls = resolve_class(cfg.get("anomaly.notifier.class"))
+        try:
+            notifier: AnomalyNotifier = notifier_cls(
+                broker_failure_alert_threshold_ms=cfg.get("broker.failure.alert.threshold.ms"),
+                broker_failure_self_healing_threshold_ms=cfg.get(
+                    "broker.failure.self.healing.threshold.ms"
+                ),
+            )
+        except TypeError:
+            notifier = notifier_cls()
+        if not cfg.get("self.healing.enabled") and hasattr(notifier, "_enabled"):
+            for t in list(notifier._enabled):
+                notifier._enabled[t] = False
+        self.anomaly_manager = AnomalyDetectorManager(
+            self.cruise_control, notifier, detectors
+        )
+        self.provisioner: Provisioner = cfg.get_configured_instance(
+            "provisioner.class", Provisioner
+        )
+        self.app = CruiseControlApp(
+            self.cruise_control,
+            anomaly_manager=self.anomaly_manager,
+            provisioner=self.provisioner if cfg.get("provisioner.enable") else None,
+            security=_security(cfg),
+            two_step_verification=cfg.get("two.step.verification.enabled"),
+            proposal_cache_ttl_s=cfg.get("proposal.expiration.ms") / 1000.0,
+        )
+        self._server = None
+        self._sampling_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, serve_http: bool = True) -> None:
+        """startUp(): begin sampling + detection (+ HTTP unless embedded)."""
+        self.cruise_control.start()
+        self.anomaly_manager.start_detection()
+        interval_s = self.config.get("metric.sampling.interval.ms") / 1000.0
+
+        def _sampling_loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.monitor.sample_once()
+                except Exception:   # sampling must survive transient backend errors
+                    pass
+
+        self._sampling_thread = threading.Thread(target=_sampling_loop, daemon=True)
+        self._sampling_thread.start()
+        if serve_http:
+            self._server = make_server(
+                self.app,
+                self.config.get("webserver.http.address"),
+                self.config.get("webserver.http.port"),
+            )
+            threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+        self.anomaly_manager.shutdown()
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+
+def load_properties(path: str) -> dict:
+    """Parse a java-style .properties file (KafkaCruiseControlUtils.readConfig)."""
+    props: dict = {}
+    with open(path) as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            key, _, value = line.partition("=")
+            props[key.strip()] = value.strip()
+    return props
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="python -m cruise_control_tpu")
+    ap.add_argument("--config", help="properties file (cruisecontrol.properties)")
+    ap.add_argument("--print-config-docs", action="store_true",
+                    help="print the config doc table and exit")
+    args = ap.parse_args(argv)
+
+    if args.print_config_docs:
+        print(cruise_control_config().doc_table())
+        return 0
+
+    props = load_properties(args.config) if args.config else {}
+    app = CruiseControlTpuApp(props)
+    app.start()
+    print(
+        f"cruise-control-tpu serving on "
+        f"{app.config.get('webserver.http.address')}:{app.config.get('webserver.http.port')}"
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        app.stop()
+    return 0

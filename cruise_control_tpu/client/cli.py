@@ -1,0 +1,126 @@
+"""``cctpu`` — command-line front-end for the REST API.
+
+Counterpart of the reference's ``cccli`` (``cruisecontrolclient/client/cccli.py``):
+one subcommand per endpoint, JSON output, ``--add-parameter`` escape hatch.
+Run as ``python -m cruise_control_tpu.client.cli <endpoint> [options]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from cruise_control_tpu.client.client import ClientError, CruiseControlClient
+
+
+def _int_list(spec: str):
+    return [int(x) for x in spec.split(",") if x]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="cctpu", description=__doc__)
+    ap.add_argument("-a", "--address", default="http://127.0.0.1:9090",
+                    help="cruise-control-tpu base URL")
+    ap.add_argument("-u", "--user", default=None)
+    ap.add_argument("-p", "--password", default=None)
+    ap.add_argument("--no-wait", action="store_true",
+                    help="return the User-Task-ID instead of polling to completion")
+    sub = ap.add_subparsers(dest="endpoint", required=True)
+
+    for name in ("state", "load", "proposals", "kafka_cluster_state", "user_tasks",
+                 "review_board", "permissions", "bootstrap", "train"):
+        sub.add_parser(name)
+
+    pl = sub.add_parser("partition_load")
+    pl.add_argument("--resource", default="DISK")
+    pl.add_argument("--entries", type=int, default=20)
+
+    for name in ("rebalance", "fix_offline_replicas", "rightsize"):
+        p = sub.add_parser(name)
+        p.add_argument("--dryrun", action="store_true", default=False)
+        p.add_argument("--execute", dest="dryrun", action="store_false")
+        if name == "rebalance":
+            p.add_argument("--goals", default=None, help="comma-separated goal names")
+            p.add_argument("--excluded-topics", default=None)
+
+    for name in ("add_broker", "remove_broker", "demote_broker"):
+        p = sub.add_parser(name)
+        p.add_argument("brokers", help="comma-separated broker ids")
+        p.add_argument("--dryrun", action="store_true", default=False)
+        p.add_argument("--execute", dest="dryrun", action="store_false")
+
+    td = sub.add_parser("topic_configuration")
+    td.add_argument("topic")
+    td.add_argument("replication_factor", type=int)
+    td.add_argument("--dryrun", action="store_true", default=False)
+    td.add_argument("--execute", dest="dryrun", action="store_false")
+
+    rd = sub.add_parser("remove_disks")
+    rd.add_argument("spec", help="brokerid-logdir[,brokerid-logdir...]")
+    rd.add_argument("--dryrun", action="store_true", default=False)
+    rd.add_argument("--execute", dest="dryrun", action="store_false")
+
+    sub.add_parser("stop_proposal_execution")
+    for name in ("pause_sampling", "resume_sampling"):
+        p = sub.add_parser(name)
+        p.add_argument("--reason", default="cctpu")
+
+    rv = sub.add_parser("review")
+    rv.add_argument("--approve", default=None, help="comma-separated review ids")
+    rv.add_argument("--discard", default=None, help="comma-separated review ids")
+    rv.add_argument("--reason", default=None)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    client = CruiseControlClient(args.address, args.user, args.password)
+    wait = not args.no_wait
+    try:
+        ep = args.endpoint
+        if ep in ("state", "load", "proposals", "kafka_cluster_state", "user_tasks",
+                  "review_board", "permissions", "bootstrap", "train"):
+            out = getattr(client, ep)()
+        elif ep == "partition_load":
+            out = client.partition_load(resource=args.resource, entries=args.entries)
+        elif ep == "rebalance":
+            goals = args.goals.split(",") if args.goals else None
+            out = client.rebalance(dryrun=args.dryrun, goals=goals,
+                                   excluded_topics=args.excluded_topics, wait=wait)
+        elif ep in ("add_broker", "remove_broker", "demote_broker"):
+            out = getattr(client, ep)(_int_list(args.brokers), dryrun=args.dryrun, wait=wait)
+        elif ep == "fix_offline_replicas":
+            out = client.fix_offline_replicas(dryrun=args.dryrun, wait=wait)
+        elif ep == "rightsize":
+            out = client.rightsize(dryrun=args.dryrun, wait=wait)
+        elif ep == "topic_configuration":
+            out = client.topic_configuration(args.topic, args.replication_factor,
+                                             dryrun=args.dryrun, wait=wait)
+        elif ep == "remove_disks":
+            pairs = []
+            for part in args.spec.split(","):
+                b, _, d = part.partition("-")
+                pairs.append((int(b), d))
+            out = client.remove_disks(pairs, dryrun=args.dryrun, wait=wait)
+        elif ep == "stop_proposal_execution":
+            out = client.stop_proposal_execution()
+        elif ep in ("pause_sampling", "resume_sampling"):
+            out = getattr(client, ep)(reason=args.reason)
+        elif ep == "review":
+            out = client.review(
+                approve=_int_list(args.approve) if args.approve else None,
+                discard=_int_list(args.discard) if args.discard else None,
+                reason=args.reason,
+            )
+        else:  # pragma: no cover - argparse guards
+            raise SystemExit(2)
+    except ClientError as e:
+        print(json.dumps({"status": e.status, "error": e.body}, indent=2), file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,229 @@
+"""Programmatic REST client with async-task polling.
+
+Mirrors the behavior of the reference client (``cruise-control-client``,
+``Endpoint.py`` + ``Responder``/``ExecutionContext``): every endpoint is a typed
+method; POSTs that return 202 carry a ``User-Task-ID`` which the client polls via
+USER_TASKS until the operation completes (or ``wait=False`` returns the task id
+immediately).  Stdlib-only (urllib) — the client must work in bare environments.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+class ClientError(Exception):
+    """Non-2xx response; carries the HTTP status and decoded body."""
+
+    def __init__(self, status: int, body: Any):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+
+
+class CruiseControlClient:
+    API_PREFIX = "/kafkacruisecontrol"
+
+    def __init__(
+        self,
+        base_url: str = "http://127.0.0.1:9090",
+        username: Optional[str] = None,
+        password: Optional[str] = None,
+        poll_interval_s: float = 0.5,
+        poll_timeout_s: float = 600.0,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.poll_interval_s = poll_interval_s
+        self.poll_timeout_s = poll_timeout_s
+        self._auth = None
+        if username is not None:
+            token = base64.b64encode(f"{username}:{password or ''}".encode()).decode()
+            self._auth = f"Basic {token}"
+
+    # -- transport -----------------------------------------------------------
+
+    def _request(
+        self, method: str, endpoint: str, params: Optional[Dict[str, Any]] = None
+    ) -> Tuple[int, Any, Dict[str, str]]:
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in (params or {}).items() if v is not None}
+        )
+        url = f"{self.base_url}{self.API_PREFIX}/{endpoint}"
+        if qs:
+            url += f"?{qs}"
+        req = urllib.request.Request(url, method=method, data=b"" if method == "POST" else None)
+        if self._auth:
+            req.add_header("Authorization", self._auth)
+        try:
+            with urllib.request.urlopen(req) as resp:
+                body = json.loads(resp.read() or b"{}")
+                return resp.status, body, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                body = json.loads(raw) if raw else {}
+            except json.JSONDecodeError:
+                body = {"raw": raw.decode(errors="replace")}
+            if e.code >= 400:
+                raise ClientError(e.code, body) from None
+            return e.code, body, dict(e.headers)
+
+    def _get(self, endpoint: str, **params) -> Any:
+        status, body, _ = self._request("GET", endpoint, params)
+        if status >= 400:
+            raise ClientError(status, body)
+        return body
+
+    def _post(self, endpoint: str, wait: bool = True, **params) -> Any:
+        status, body, headers = self._request("POST", endpoint, params)
+        if status >= 400:
+            raise ClientError(status, body)
+        if status == 202:
+            task_id = headers.get("User-Task-ID") or body.get("userTaskId")
+            if not wait:
+                return {"userTaskId": task_id, "accepted": True}
+            return self._await_task(task_id)
+        return body
+
+    def _await_task(self, task_id: str) -> Any:
+        """Poll USER_TASKS until the task completes (Responder's retry loop)."""
+        deadline = time.monotonic() + self.poll_timeout_s
+        while time.monotonic() < deadline:
+            body = self._get("user_tasks", user_task_ids=task_id)
+            tasks = body.get("userTasks", [])
+            for t in tasks:
+                if t.get("UserTaskId") != task_id:
+                    continue
+                status = t.get("Status")
+                if status == "Completed":
+                    return t.get("result", t)
+                if status == "CompletedWithError":
+                    raise ClientError(500, t)
+            time.sleep(self.poll_interval_s)
+        raise TimeoutError(f"user task {task_id} did not complete in {self.poll_timeout_s}s")
+
+    # -- GET endpoints (CruiseControlEndPoint.java:16-26) --------------------
+
+    def state(self) -> Any:
+        return self._get("state")
+
+    def load(self) -> Any:
+        return self._get("load")
+
+    def partition_load(self, resource: str = "DISK", start: int = 0, entries: int = 20) -> Any:
+        return self._get("partition_load", resource=resource, start=start, entries=entries)
+
+    def proposals(self, ignore_proposal_cache: bool = False) -> Any:
+        return self._get(
+            "proposals", ignore_proposal_cache=str(ignore_proposal_cache).lower()
+        )
+
+    def kafka_cluster_state(self) -> Any:
+        return self._get("kafka_cluster_state")
+
+    def user_tasks(self, user_task_ids: Optional[str] = None) -> Any:
+        return self._get("user_tasks", user_task_ids=user_task_ids)
+
+    def review_board(self) -> Any:
+        return self._get("review_board")
+
+    def permissions(self) -> Any:
+        return self._get("permissions")
+
+    def bootstrap(self, start: Optional[int] = None, end: Optional[int] = None) -> Any:
+        return self._get("bootstrap", start=start, end=end)
+
+    def train(self, start: Optional[int] = None, end: Optional[int] = None) -> Any:
+        return self._get("train", start=start, end=end)
+
+    # -- POST endpoints (:27-39) ---------------------------------------------
+
+    @staticmethod
+    def _csv(values: Optional[Iterable[Any]]) -> Optional[str]:
+        if values is None:
+            return None
+        vals = list(values)
+        return ",".join(str(v) for v in vals) if vals else None
+
+    def rebalance(
+        self,
+        dryrun: bool = True,
+        goals: Optional[Sequence[str]] = None,
+        excluded_topics: Optional[str] = None,
+        wait: bool = True,
+    ) -> Any:
+        return self._post(
+            "rebalance", wait=wait, dryrun=str(dryrun).lower(),
+            goals=self._csv(goals), excluded_topics=excluded_topics,
+        )
+
+    def add_broker(self, broker_ids: Sequence[int], dryrun: bool = True, wait: bool = True) -> Any:
+        return self._post(
+            "add_broker", wait=wait, brokerid=self._csv(broker_ids),
+            dryrun=str(dryrun).lower(),
+        )
+
+    def remove_broker(self, broker_ids: Sequence[int], dryrun: bool = True, wait: bool = True) -> Any:
+        return self._post(
+            "remove_broker", wait=wait, brokerid=self._csv(broker_ids),
+            dryrun=str(dryrun).lower(),
+        )
+
+    def demote_broker(self, broker_ids: Sequence[int], dryrun: bool = True, wait: bool = True) -> Any:
+        return self._post(
+            "demote_broker", wait=wait, brokerid=self._csv(broker_ids),
+            dryrun=str(dryrun).lower(),
+        )
+
+    def fix_offline_replicas(self, dryrun: bool = True, wait: bool = True) -> Any:
+        return self._post("fix_offline_replicas", wait=wait, dryrun=str(dryrun).lower())
+
+    def topic_configuration(
+        self, topic: str, replication_factor: int, dryrun: bool = True, wait: bool = True
+    ) -> Any:
+        return self._post(
+            "topic_configuration", wait=wait, topic=topic,
+            replication_factor=replication_factor, dryrun=str(dryrun).lower(),
+        )
+
+    def rightsize(self, dryrun: bool = True, wait: bool = True) -> Any:
+        return self._post("rightsize", wait=wait, dryrun=str(dryrun).lower())
+
+    def remove_disks(
+        self, broker_id_and_logdirs: Sequence[Tuple[int, str]], dryrun: bool = True,
+        wait: bool = True,
+    ) -> Any:
+        spec = ",".join(f"{b}-{d}" for b, d in broker_id_and_logdirs)
+        return self._post(
+            "remove_disks", wait=wait, brokerid_and_logdirs=spec,
+            dryrun=str(dryrun).lower(),
+        )
+
+    def stop_proposal_execution(self) -> Any:
+        return self._post("stop_proposal_execution")
+
+    def pause_sampling(self, reason: str = "client request") -> Any:
+        return self._post("pause_sampling", reason=reason)
+
+    def resume_sampling(self, reason: str = "client request") -> Any:
+        return self._post("resume_sampling", reason=reason)
+
+    def admin(self, **params) -> Any:
+        return self._post("admin", **params)
+
+    def review(
+        self,
+        approve: Optional[Sequence[int]] = None,
+        discard: Optional[Sequence[int]] = None,
+        reason: Optional[str] = None,
+    ) -> Any:
+        return self._post(
+            "review", approve=self._csv(approve), discard=self._csv(discard),
+            reason=reason,
+        )

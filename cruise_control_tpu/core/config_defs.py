@@ -1,0 +1,222 @@
+"""Grouped configuration definitions with the reference's key names.
+
+Counterpart of ``config/constants/{MonitorConfig,AnalyzerConfig,ExecutorConfig,
+AnomalyDetectorConfig,WebServerConfig}.java`` and ``KafkaCruiseControlConfig``:
+each group is a ``ConfigDef`` built on the typed kernel in
+:mod:`cruise_control_tpu.core.config`; :func:`cruise_control_config` merges them
+into the one registry the app shell resolves a properties file against
+(``KafkaCruiseControlMain.java:26``).
+
+Key names, defaults, and bounds mirror the reference wherever the knob maps onto
+this framework (file:line cited per group); knobs tied to JVM/Kafka-client
+plumbing (admin client timeouts, ZK paths, Jetty internals) are intentionally
+absent — the backend SPI replaces them.
+"""
+
+from __future__ import annotations
+
+from cruise_control_tpu.core.config import ConfigDef, Importance, Type, in_range
+
+H, M, L = Importance.HIGH, Importance.MEDIUM, Importance.LOW
+
+
+def monitor_config() -> ConfigDef:
+    """MonitorConfig.java — sampling / windowing / capacity resolution."""
+    d = ConfigDef()
+    d.define("num.partition.metrics.windows", Type.INT, 5, H,
+             "Number of partition-metric windows the aggregator retains.",
+             in_range(lo=1))
+    d.define("partition.metrics.window.ms", Type.LONG, 3_600_000, H,
+             "Span of one partition-metric window in milliseconds.", in_range(lo=1))
+    d.define("min.samples.per.partition.metrics.window", Type.INT, 1, M,
+             "Samples a window needs before it counts as valid.", in_range(lo=1))
+    d.define("metric.sampling.interval.ms", Type.LONG, 120_000, M,
+             "Interval between metric sampling runs.", in_range(lo=1))
+    d.define("min.valid.partition.ratio", Type.DOUBLE, 0.995, M,
+             "Monitored-partition coverage required to serve a cluster model.",
+             in_range(0.0, 1.0))
+    d.define("broker.capacity.config.resolver.class", Type.CLASS,
+             "cruise_control_tpu.monitor.capacity.FileCapacityResolver", M,
+             "BrokerCapacityResolver implementation.")
+    d.define("capacity.config.file", Type.STRING, "config/capacity.json", M,
+             "Capacity file for the file resolver (capacity.json / capacityJBOD.json).")
+    d.define("metric.sampler.class", Type.CLASS,
+             "cruise_control_tpu.monitor.samples.BackendMetricSampler", M,
+             "MetricSampler implementation.")
+    d.define("sample.store.class", Type.CLASS,
+             "cruise_control_tpu.monitor.samplestore.FileSampleStore", M,
+             "SampleStore implementation for persist/replay of samples.")
+    d.define("sample.store.dir", Type.STRING, "/tmp/cruise-control-tpu-samples", L,
+             "Directory for the file sample store.")
+    d.define("skip.loading.samples", Type.BOOLEAN, False, L,
+             "Skip replaying persisted samples on startup.")
+    d.define("use.linear.regression.model", Type.BOOLEAN, False, L,
+             "Use the TRAIN-fitted linear CPU model instead of the static weights.")
+    d.define("leader.network.inbound.weight.for.cpu.util", Type.DOUBLE, 0.7, L,
+             "Static CPU model weight a (ModelUtils).", in_range(0.0, 1.0))
+    d.define("leader.network.outbound.weight.for.cpu.util", Type.DOUBLE, 0.15, L,
+             "Static CPU model weight b.", in_range(0.0, 1.0))
+    d.define("follower.network.inbound.weight.for.cpu.util", Type.DOUBLE, 0.15, L,
+             "Static CPU model weight c.", in_range(0.0, 1.0))
+    return d
+
+
+def analyzer_config() -> ConfigDef:
+    """AnalyzerConfig.java — goal list, thresholds, balancedness weights."""
+    d = ConfigDef()
+    d.define("default.goals", Type.LIST, "", H,
+             "Goal names (reference class names) in priority order; empty = framework default list.")
+    d.define("hard.goals", Type.LIST, "", H,
+             "Hard-goal names; empty = framework default hard goals.")
+    d.define("intra.broker.goals", Type.LIST,
+             "IntraBrokerDiskCapacityGoal,IntraBrokerDiskUsageDistributionGoal", M,
+             "JBOD intra-broker goal names.")
+    for res in ("cpu", "disk", "network.inbound", "network.outbound"):
+        d.define(f"{res}.balance.threshold", Type.DOUBLE, 1.10, M,
+                 f"Balanced-ness band multiplier for {res}.", in_range(lo=1.0))
+        d.define(f"{res}.low.utilization.threshold", Type.DOUBLE, 0.0, L,
+                 f"Below this average utilization {res} is not balanced.",
+                 in_range(0.0, 1.0))
+    d.define("cpu.capacity.threshold", Type.DOUBLE, 0.7, M,
+             "Usable fraction of CPU capacity.", in_range(0.0, 1.0))
+    d.define("disk.capacity.threshold", Type.DOUBLE, 0.8, M,
+             "Usable fraction of disk capacity.", in_range(0.0, 1.0))
+    d.define("network.inbound.capacity.threshold", Type.DOUBLE, 0.8, M,
+             "Usable fraction of inbound network capacity.", in_range(0.0, 1.0))
+    d.define("network.outbound.capacity.threshold", Type.DOUBLE, 0.8, M,
+             "Usable fraction of outbound network capacity.", in_range(0.0, 1.0))
+    d.define("replica.count.balance.threshold", Type.DOUBLE, 1.10, M,
+             "Replica-count band multiplier.", in_range(lo=1.0))
+    d.define("leader.replica.count.balance.threshold", Type.DOUBLE, 1.10, M,
+             "Leader-count band multiplier.", in_range(lo=1.0))
+    d.define("topic.replica.count.balance.threshold", Type.DOUBLE, 3.0, L,
+             "Per-topic replica-count band multiplier.", in_range(lo=1.0))
+    d.define("topic.replica.count.balance.min.gap", Type.INT, 2, L,
+             "Minimum per-topic count gap.", in_range(lo=0))
+    d.define("topic.replica.count.balance.max.gap", Type.INT, 40, L,
+             "Maximum per-topic count gap.", in_range(lo=0))
+    d.define("max.replicas.per.broker", Type.LONG, 10_000, M,
+             "ReplicaCapacityGoal limit.", in_range(lo=1))
+    d.define("min.topic.leaders.per.broker", Type.INT, 1, L,
+             "MinTopicLeadersPerBrokerGoal minimum.", in_range(lo=0))
+    d.define("topics.with.min.leaders.per.broker", Type.STRING, "", L,
+             "Regex of topics subject to MinTopicLeadersPerBrokerGoal.")
+    d.define("goal.violation.distribution.threshold.multiplier", Type.DOUBLE, 1.0, L,
+             "Detector band widening multiplier.", in_range(lo=1.0))
+    d.define("goal.balancedness.priority.weight", Type.DOUBLE, 1.1, L,
+             "Per-priority-level balancedness weight.", in_range(lo=0.0))
+    d.define("goal.balancedness.strictness.weight", Type.DOUBLE, 1.5, L,
+             "Hard-goal balancedness weight.", in_range(lo=0.0))
+    d.define("proposal.expiration.ms", Type.LONG, 900_000, M,
+             "Cached proposal freshness horizon.", in_range(lo=0))
+    d.define("num.proposal.precompute.threads", Type.INT, 1, L,
+             "Background proposal precompute workers.", in_range(lo=0))
+    d.define("max.moves.per.broker.per.round", Type.INT, 8, L,
+             "Solver top-k: candidate actions nominated per broker per round "
+             "(TPU-specific; the depth of the parallel SortedReplicas walk).",
+             in_range(lo=1))
+    return d
+
+
+def executor_config() -> ConfigDef:
+    """ExecutorConfig.java — movement concurrency, throttles, progress checks."""
+    d = ConfigDef()
+    d.define("num.concurrent.partition.movements.per.broker", Type.INT, 5, H,
+             "Per-broker inter-broker move cap.", in_range(lo=1))
+    d.define("max.num.cluster.partition.movements", Type.INT, 1250, M,
+             "Cluster-wide inter-broker move cap.", in_range(lo=1))
+    d.define("num.concurrent.intra.broker.partition.movements", Type.INT, 2, M,
+             "Intra-broker (logdir) move cap.", in_range(lo=1))
+    d.define("num.concurrent.leader.movements", Type.INT, 1000, M,
+             "Leadership-change batch size.", in_range(lo=1))
+    d.define("execution.progress.check.interval.ms", Type.LONG, 10_000, M,
+             "Interval between execution progress checks.", in_range(lo=1))
+    d.define("default.replication.throttle", Type.LONG, None, L,
+             "Replication throttle (bytes/s) applied during executions; unset = none.")
+    d.define("concurrency.adjuster.interval.ms", Type.LONG, 360_000, L,
+             "AIMD concurrency adjuster tick.", in_range(lo=1))
+    d.define("concurrency.adjuster.min.isr.check.enabled", Type.BOOLEAN, True, L,
+             "Gate concurrency increases on (At/Under)MinISR state.")
+    d.define("executor.notifier.class", Type.CLASS,
+             "cruise_control_tpu.executor.engine.ExecutorNotifier", L,
+             "ExecutorNotifier implementation.")
+    d.define("demotion.history.retention.time.ms", Type.LONG, 86_400_000, L,
+             "Retention of broker demotion history.", in_range(lo=0))
+    d.define("removal.history.retention.time.ms", Type.LONG, 86_400_000, L,
+             "Retention of broker removal history.", in_range(lo=0))
+    return d
+
+
+def anomaly_detector_config() -> ConfigDef:
+    """AnomalyDetectorConfig.java — detection cadence, self-healing, notifier."""
+    d = ConfigDef()
+    d.define("anomaly.detection.interval.ms", Type.LONG, 300_000, H,
+             "Default detector cadence.", in_range(lo=1))
+    d.define("goal.violation.detection.interval.ms", Type.LONG, None, M,
+             "Goal-violation detector cadence; unset = anomaly.detection.interval.ms.")
+    d.define("broker.failure.detection.interval.ms", Type.LONG, None, M,
+             "Broker-failure detector cadence; unset = anomaly.detection.interval.ms.")
+    d.define("disk.failure.detection.interval.ms", Type.LONG, None, M,
+             "Disk-failure detector cadence; unset = anomaly.detection.interval.ms.")
+    d.define("metric.anomaly.detection.interval.ms", Type.LONG, None, M,
+             "Metric-anomaly (slow broker) cadence; unset = anomaly.detection.interval.ms.")
+    d.define("topic.anomaly.detection.interval.ms", Type.LONG, None, M,
+             "Topic-anomaly cadence; unset = anomaly.detection.interval.ms.")
+    d.define("anomaly.detection.goals", Type.LIST, "", M,
+             "Goal names the violation detector re-optimizes; empty = default list.")
+    d.define("anomaly.notifier.class", Type.CLASS,
+             "cruise_control_tpu.detector.notifier.SelfHealingNotifier", M,
+             "AnomalyNotifier implementation.")
+    d.define("self.healing.enabled", Type.BOOLEAN, False, H,
+             "Master switch for self-healing across anomaly types.")
+    d.define("broker.failure.alert.threshold.ms", Type.LONG, 900_000, M,
+             "Grace period before a broker failure alerts.", in_range(lo=0))
+    d.define("broker.failure.self.healing.threshold.ms", Type.LONG, 1_800_000, M,
+             "Grace period before a broker failure self-heals.", in_range(lo=0))
+    d.define("failed.brokers.file.path", Type.STRING,
+             "/tmp/cruise-control-tpu-failed-brokers.txt", L,
+             "Persisted failed-broker times (survive restarts).")
+    d.define("provisioner.class", Type.CLASS,
+             "cruise_control_tpu.detector.provisioner.BasicProvisioner", L,
+             "Provisioner implementation for rightsizing.")
+    d.define("provisioner.enable", Type.BOOLEAN, True, L,
+             "Whether rightsizing consults the provisioner.")
+    return d
+
+
+def webserver_config() -> ConfigDef:
+    """WebServerConfig.java — HTTP endpoint, auth, two-step verification."""
+    d = ConfigDef()
+    d.define("webserver.http.address", Type.STRING, "127.0.0.1", H,
+             "Bind address of the REST API.")
+    d.define("webserver.http.port", Type.INT, 9090, H,
+             "Port of the REST API (0 = ephemeral).", in_range(0, 65535))
+    d.define("webserver.api.urlprefix", Type.STRING, "/kafkacruisecontrol/*", L,
+             "URL prefix of the API.")
+    d.define("webserver.security.enable", Type.BOOLEAN, False, M,
+             "Enable HTTP authentication.")
+    d.define("webserver.auth.credentials.file", Type.STRING, "", M,
+             "Credentials file: 'user: password, ROLE' per line (Jetty realm format).")
+    d.define("two.step.verification.enabled", Type.BOOLEAN, False, M,
+             "Park POSTs in the purgatory until reviewed.")
+    d.define("two.step.purgatory.retention.time.ms", Type.LONG, 1_209_600_000, L,
+             "Retention of reviewed requests.", in_range(lo=0))
+    d.define("two.step.purgatory.max.requests", Type.INT, 25, L,
+             "Maximum pending review requests.", in_range(lo=1))
+    d.define("max.active.user.tasks", Type.INT, 25, L,
+             "Concurrent async user tasks.", in_range(lo=1))
+    return d
+
+
+def cruise_control_config() -> ConfigDef:
+    """The merged registry (KafkaCruiseControlConfig)."""
+    d = ConfigDef()
+    for group in (
+        monitor_config(),
+        analyzer_config(),
+        executor_config(),
+        anomaly_detector_config(),
+        webserver_config(),
+    ):
+        d.merge(group)
+    return d

@@ -1,0 +1,188 @@
+"""Observability sensor registry: timers, gauges, counters per subsystem.
+
+Counterpart of the reference's Dropwizard ``MetricRegistry`` → JMX surface
+(``kafka.cruisecontrol`` domain; sensor families documented in
+``docs/wiki/User Guide/Sensors.md``; registration sites e.g. GoalOptimizer.java:84,
+LoadMonitor.java:101, Executor.java:145-148, AnomalyDetectorManager's MTBA).
+
+Python-idiomatic: one process-wide :class:`SensorRegistry` of named metrics with
+O(1) lock-free-ish updates (GIL-atomic ops), snapshot export for the STATE
+endpoint, and a ``timer()`` context manager for the hot paths.  No JMX — the
+export surface is the REST API (and anything that scrapes it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class Timer:
+    """Duration histogram: count, mean, max, last, p50/p95 over a ring buffer."""
+
+    def __init__(self, window: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._ring: List[float] = []
+        self._window = window
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.last_s = 0.0
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.last_s = seconds
+            self.max_s = max(self.max_s, seconds)
+            self._ring.append(seconds)
+            if len(self._ring) > self._window:
+                self._ring.pop(0)
+
+    @contextmanager
+    def time(self):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.update(time.monotonic() - t0)
+
+    def _percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._ring:
+                return 0.0
+            data = sorted(self._ring)
+        idx = min(int(q * len(data)), len(data) - 1)
+        return data[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "mean_s": self.total_s / self.count if self.count else 0.0,
+            "max_s": self.max_s,
+            "last_s": self.last_s,
+            "p50_s": self._percentile(0.50),
+            "p95_s": self._percentile(0.95),
+        }
+
+
+class Gauge:
+    """Last-written value (e.g. balancedness score, valid-window count)."""
+
+    def __init__(self) -> None:
+        self.value: float = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+    def snapshot(self) -> float:
+        return self.value
+
+
+class Counter:
+    """Monotonic event count (e.g. proposals computed, anomalies handled)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+    def snapshot(self) -> int:
+        return self.value
+
+
+class Meter:
+    """Event rate over a sliding window (mean rate + 1-minute-ish rate)."""
+
+    def __init__(self, window_s: float = 60.0) -> None:
+        self._lock = threading.Lock()
+        self._events: List[float] = []
+        self.window_s = window_s
+        self.total = 0
+
+    def mark(self, n: int = 1) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.total += n
+            self._events.extend([now] * n)
+            cutoff = now - self.window_s
+            while self._events and self._events[0] < cutoff:
+                self._events.pop(0)
+
+    def snapshot(self) -> Dict[str, float]:
+        now = time.monotonic()
+        with self._lock:
+            recent = sum(1 for t in self._events if t >= now - self.window_s)
+        return {"total": self.total, "rate_per_s": recent / self.window_s}
+
+
+class SensorRegistry:
+    """Named sensors, grouped dot-separated like the reference's JMX names
+    (``LoadMonitor.cluster-model-creation-timer`` & co)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._timers: Dict[str, Timer] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._counters: Dict[str, Counter] = {}
+        self._meters: Dict[str, Meter] = {}
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            return self._timers.setdefault(name, Timer())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def meter(self, name: str) -> Meter:
+        with self._lock:
+            return self._meters.setdefault(name, Meter())
+
+    def snapshot(self, prefix: Optional[str] = None) -> Dict[str, object]:
+        """Export for the STATE endpoint / scrapers (Sensors.md families)."""
+        out: Dict[str, object] = {}
+        with self._lock:
+            groups = [
+                ("timers", self._timers),
+                ("gauges", self._gauges),
+                ("counters", self._counters),
+                ("meters", self._meters),
+            ]
+            for kind, group in groups:
+                sub = {
+                    name: sensor.snapshot()
+                    for name, sensor in group.items()
+                    if prefix is None or name.startswith(prefix)
+                }
+                if sub:
+                    out[kind] = sub
+        return out
+
+
+#: Process-wide default registry (the reference's singleton MetricRegistry).
+REGISTRY = SensorRegistry()
+
+# Sensor names used across subsystems — mirrors Sensors.md so operators can map
+# dashboards one-to-one.
+PROPOSAL_COMPUTATION_TIMER = "GoalOptimizer.proposal-computation-timer"
+CLUSTER_MODEL_CREATION_TIMER = "LoadMonitor.cluster-model-creation-timer"
+PROPOSAL_EXECUTION_TIMER = "Executor.proposal-execution-timer"
+GOAL_VIOLATION_DETECTION_TIMER = "GoalViolationDetector.detection-timer"
+BALANCEDNESS_GAUGE = "AnomalyDetector.balancedness-score"
+MTBA_GAUGE = "AnomalyDetector.mean-time-between-anomalies-ms"
+ANOMALY_RATE_METER = "AnomalyDetector.anomaly-rate"
+SAMPLE_FETCH_TIMER = "MetricFetcherManager.samples-fetch-timer"
+VALID_WINDOWS_GAUGE = "LoadMonitor.valid-windows"
+MONITORED_PARTITIONS_GAUGE = "LoadMonitor.monitored-partitions-percentage"
+EXECUTION_STARTED_COUNTER = "Executor.execution-started"
+EXECUTION_STOPPED_COUNTER = "Executor.execution-stopped"

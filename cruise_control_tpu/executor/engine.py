@@ -117,6 +117,7 @@ class Executor:
         proposals: Sequence[ExecutionProposal],
         strategy_ctx: Optional[StrategyContext] = None,
         wait: bool = True,
+        logdir_moves: Optional[Dict] = None,
     ) -> ExecutionSummary:
         """Run the 3-phase execution; rejects when one is ongoing
         (Executor.java:810 synchronized semantics)."""
@@ -126,7 +127,7 @@ class Executor:
             self._stop_signal.clear()
             self._state = ExecutorState.STARTING_EXECUTION
             planner = ExecutionTaskPlanner(self.strategies, strategy_ctx)
-            planner.add_proposals(list(proposals))
+            planner.add_proposals(list(proposals), logdir_moves=logdir_moves)
             self._planner = planner
             execution_id = next(self._execution_ids)
             self._execution_thread = threading.Thread(

@@ -48,12 +48,20 @@ class ExecutionTaskPlanner:
                 )
             if p.has_leader_action:
                 self.leadership.append(ExecutionTask(p, TaskType.LEADER_ACTION))
+        by_tp = {p.tp: p for p in proposals}
         for (tp, broker), path in (logdir_moves or {}).items():
-            for p in proposals:
-                if p.tp == tp:
-                    t = ExecutionTask(p, TaskType.INTRA_BROKER_REPLICA_ACTION)
-                    t.logdir_move = (broker, path)
-                    self.intra_broker.append(t)
+            p = by_tp.get(tp)
+            if p is None:
+                # logdir-only change: no placement diff exists, so synthesize a
+                # no-op proposal to carry the task (the reference plans intra-
+                # broker tasks from ExecutionProposal logdir info directly)
+                p = ExecutionProposal(
+                    tp=tp, partition_size=0.0, old_leader=None,
+                    old_replicas=(broker,), new_replicas=(broker,),
+                )
+            t = ExecutionTask(p, TaskType.INTRA_BROKER_REPLICA_ACTION)
+            t.logdir_move = (broker, path)
+            self.intra_broker.append(t)
         self.inter_broker.sort(key=lambda t: self._strategy.sort_key(t, self._ctx))
 
     # -- ready-task selection ------------------------------------------------

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer, OptimizerResult
 from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.proposals import logdir_moves
 from cruise_control_tpu.analyzer.constraint import BalancingConstraint
 from cruise_control_tpu.backend.base import ClusterBackend
 from cruise_control_tpu.executor import Executor, ExecutionSummary
@@ -73,10 +74,14 @@ class CruiseControl:
     ) -> ClusterModel:
         return self.monitor.cluster_model(requirements=requirements)
 
-    def _optimizer(self, goal_ids: Optional[Sequence[int]]) -> GoalOptimizer:
+    def _optimizer(
+        self,
+        goal_ids: Optional[Sequence[int]],
+        hard_ids: Optional[Sequence[int]] = None,
+    ) -> GoalOptimizer:
         return GoalOptimizer(
             goal_ids=tuple(goal_ids) if goal_ids is not None else self.goal_ids,
-            hard_ids=self.hard_ids,
+            hard_ids=tuple(hard_ids) if hard_ids is not None else self.hard_ids,
             enable_heavy_goals=self.enable_heavy_goals,
         )
 
@@ -122,14 +127,18 @@ class CruiseControl:
         model: ClusterModel,
         dryrun: bool,
         goal_ids: Optional[Sequence[int]] = None,
+        hard_ids: Optional[Sequence[int]] = None,
         **ctx_kw,
     ) -> OperationResult:
         state, maps = model.to_arrays()
         ctx = self._context(model, maps, state, **ctx_kw)
-        final, result = self._optimizer(goal_ids).optimize(state, ctx, maps=maps)
+        final, result = self._optimizer(goal_ids, hard_ids).optimize(state, ctx, maps=maps)
+        ld_moves = logdir_moves(state, final, maps)
         execution = None
-        if not dryrun and result.proposals:
-            execution = self.executor.execute_proposals(result.proposals)
+        if not dryrun and (result.proposals or ld_moves):
+            execution = self.executor.execute_proposals(
+                result.proposals, logdir_moves=ld_moves
+            )
         return OperationResult(result, execution, dryrun)
 
     def rebalance(
@@ -189,6 +198,23 @@ class CruiseControl:
         dead disks; the goal list then re-balances."""
         model = self.cluster_model()
         return self._optimize_and_maybe_execute(model, dryrun, **kw)
+
+    def remove_disks(
+        self, broker_logdirs: Sequence[Tuple[int, str]], dryrun: bool = True, **kw
+    ) -> OperationResult:
+        """POST /remove_disks (RemoveDisksRunnable): drain the named logdirs to
+        their brokers' remaining disks via the JBOD intra-broker goals — the
+        replicas never leave their broker (contrast DiskFailures, whose fix is
+        cross-broker relocation of offline replicas)."""
+        model = self.cluster_model()
+        for b, logdir in broker_logdirs:
+            model.mark_disk_removed(b, logdir)
+        return self._optimize_and_maybe_execute(
+            model, dryrun,
+            goal_ids=G.INTRA_BROKER_GOALS,
+            hard_ids=(G.INTRA_DISK_CAPACITY,),
+            **kw,
+        )
 
     def update_topic_replication_factor(
         self,

@@ -265,6 +265,24 @@ def relocate_replicas(
     )
 
 
+def relocate_replica_disks(
+    state: ClusterArrays, replica_idx: jax.Array, dst_disk: jax.Array
+) -> ClusterArrays:
+    """Move replicas between logdirs of their own broker (INTRA_BROKER move,
+    Executor.intraBrokerMoveReplicas → alterReplicaLogDirs, Executor.java:1679).
+
+    Entries with ``replica_idx < 0`` are no-ops; the broker assignment is
+    untouched."""
+    replica_idx = jnp.asarray(replica_idx)
+    dst_disk = jnp.asarray(dst_disk)
+    ok = replica_idx >= 0
+    oob = jnp.int32(state.num_replicas)
+    idx = jnp.where(ok, replica_idx, oob)  # no-ops dropped (see relocate_replicas)
+    return state.replace(
+        replica_disk=state.replica_disk.at[idx].set(dst_disk, mode="drop")
+    )
+
+
 def relocate_leadership(
     state: ClusterArrays, partition_idx: jax.Array, dst_replica: jax.Array
 ) -> ClusterArrays:

@@ -55,6 +55,9 @@ class _Broker:
     state: str = BrokerState.ALIVE
     logdirs: Dict[str, float] = dataclasses.field(default_factory=dict)  # capacity per dir
     dead_logdirs: set = dataclasses.field(default_factory=set)
+    #: logdirs marked for REMOVE_DISKS: still alive (their replicas are healthy)
+    #: but zero-capacity, so the intra-broker goals drain them to siblings
+    removed_logdirs: set = dataclasses.field(default_factory=set)
 
 
 @dataclasses.dataclass
@@ -181,6 +184,16 @@ class ClusterModel:
         broker.dead_logdirs.add(logdir)
         if broker.state == BrokerState.ALIVE:
             broker.state = BrokerState.BAD_DISKS
+        self.generation += 1
+
+    def mark_disk_removed(self, broker_id: int, logdir: str) -> None:
+        """Mark a healthy logdir for removal (REMOVE_DISKS): it stays alive but
+        its capacity reads as zero, so IntraBrokerDiskCapacityGoal drains it to
+        the broker's remaining disks (RemoveDisksRunnable semantics)."""
+        broker = self._brokers[broker_id]
+        if logdir not in broker.logdirs:
+            raise ValueError(f"unknown logdir {logdir}")
+        broker.removed_logdirs.add(logdir)
         self.generation += 1
 
     # -- queries -------------------------------------------------------------
@@ -325,7 +338,11 @@ class ClusterModel:
 
         disk_broker = np.array([broker_index[b] for b, _ in disks], np.int32)
         disk_capacity = np.array(
-            [self._brokers[b].logdirs[d] for b, d in disks], np.float32
+            [
+                0.0 if d in self._brokers[b].removed_logdirs else self._brokers[b].logdirs[d]
+                for b, d in disks
+            ],
+            np.float32,
         )
         disk_alive = np.array(
             [d not in self._brokers[b].dead_logdirs for b, d in disks], bool

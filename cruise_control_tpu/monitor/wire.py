@@ -1,0 +1,125 @@
+"""Raw-metric wire format: versioned binary serde for metric transport.
+
+Counterpart of the reference's ``MetricSerde`` + per-class serialization in
+``cruise-control-metrics-reporter`` (``BrokerMetric``/``TopicMetric``/
+``PartitionMetric`` with a wire-format version header per ``RawMetricType``
+scope, RawMetricType.java:27): the broker-side reporter serializes metrics into
+the transport topic; samplers deserialize batches back.
+
+Binary layout (little-endian), one record:
+
+    u8  record version        (RECORD_VERSION; readers reject newer majors)
+    u8  scope                 (0=BROKER, 1=TOPIC, 2=PARTITION)
+    u16 metric id             (taxonomy id from core.metricdef.RAW_METRIC_IDS)
+    i32 broker id
+    i64 timestamp ms
+    f64 value
+    u16 topic length | 0      (TOPIC/PARTITION scopes)
+    ..  topic utf-8 bytes
+    i32 partition             (PARTITION scope only)
+
+A batch is ``u32 count`` followed by records.  Unknown metric ids are preserved
+through serde (forward compatibility: a newer reporter can feed an older
+sampler, which skips ids it doesn't know — the same guarantee the reference's
+versioned enum gives).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, List, Tuple
+
+from cruise_control_tpu.backend.base import RawMetric
+from cruise_control_tpu.core.metricdef import RawMetricType
+
+RECORD_VERSION = 1
+
+_SCOPES = ("BROKER", "TOPIC", "PARTITION")
+_SCOPE_ID = {s: i for i, s in enumerate(_SCOPES)}
+
+_HEAD = struct.Struct("<BBHiqd")   # version, scope, metric id, broker, ts, value
+_U16 = struct.Struct("<H")
+_I32 = struct.Struct("<i")
+_U32 = struct.Struct("<I")
+
+
+class WireFormatError(Exception):
+    """Malformed or incompatible serialized metrics."""
+
+
+def _ids() -> Tuple[dict, dict]:
+    by_name = {t.name: t.value[0] for t in RawMetricType}
+    by_id = {i: name for name, i in by_name.items()}
+    return by_name, by_id
+
+
+def serialize(metrics: Iterable[RawMetric]) -> bytes:
+    """One batch of raw metrics → bytes (reporter side, MetricSerde.toBytes)."""
+    by_name, _ = _ids()
+    records: List[bytes] = []
+    for m in metrics:
+        if m.scope not in _SCOPE_ID:
+            raise WireFormatError(f"unknown scope {m.scope!r}")
+        if m.name not in by_name:
+            raise WireFormatError(f"unknown metric name {m.name!r}")
+        parts = [
+            _HEAD.pack(
+                RECORD_VERSION, _SCOPE_ID[m.scope], by_name[m.name],
+                m.broker_id, m.ts_ms, m.value,
+            )
+        ]
+        if m.scope in ("TOPIC", "PARTITION"):
+            topic = (m.topic or "").encode()
+            parts.append(_U16.pack(len(topic)))
+            parts.append(topic)
+        if m.scope == "PARTITION":
+            parts.append(_I32.pack(m.partition if m.partition is not None else -1))
+        records.append(b"".join(parts))
+    return _U32.pack(len(records)) + b"".join(records)
+
+
+def deserialize(payload: bytes) -> List[RawMetric]:
+    """Bytes → raw metrics (sampler side, MetricSerde.fromBytes).
+
+    Records with a newer major version or an unknown metric id are skipped —
+    never fatal — so mixed-version fleets keep reporting.
+    """
+    _, by_id = _ids()
+    if len(payload) < _U32.size:
+        raise WireFormatError("truncated batch header")
+    (count,) = _U32.unpack_from(payload, 0)
+    off = _U32.size
+    out: List[RawMetric] = []
+    for _ in range(count):
+        if off + _HEAD.size > len(payload):
+            raise WireFormatError("truncated record header")
+        version, scope_id, metric_id, broker, ts, value = _HEAD.unpack_from(payload, off)
+        off += _HEAD.size
+        topic = None
+        partition = None
+        if scope_id >= len(_SCOPES):
+            raise WireFormatError(f"unknown scope id {scope_id}")
+        scope = _SCOPES[scope_id]
+        if scope in ("TOPIC", "PARTITION"):
+            if off + _U16.size > len(payload):
+                raise WireFormatError("truncated topic length")
+            (tlen,) = _U16.unpack_from(payload, off)
+            off += _U16.size
+            if off + tlen > len(payload):
+                raise WireFormatError("truncated topic")
+            topic = payload[off:off + tlen].decode()
+            off += tlen
+        if scope == "PARTITION":
+            if off + _I32.size > len(payload):
+                raise WireFormatError("truncated partition")
+            (partition,) = _I32.unpack_from(payload, off)
+            off += _I32.size
+        if version > RECORD_VERSION or metric_id not in by_id:
+            continue  # forward compatibility: skip, don't fail
+        out.append(
+            RawMetric(
+                name=by_id[metric_id], scope=scope, broker_id=broker,
+                value=value, ts_ms=ts, topic=topic, partition=partition,
+            )
+        )
+    return out

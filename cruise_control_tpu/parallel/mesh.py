@@ -85,12 +85,18 @@ def shard_state(state: ClusterArrays, mesh: Mesh) -> ClusterArrays:
     state = pad_replicas(state, n)
     repl = NamedSharding(mesh, P())
 
-    state = jax.tree.map(lambda x: jax.device_put(x, repl), state)
+    # place each replica-axis leaf ONCE, directly with its sharded layout —
+    # replicating them first would transiently cost n× the memory the
+    # sharding exists to avoid
     updates = {}
     for f in REPLICA_FIELDS:
         x = getattr(state, f)
         spec = P(REPLICA_AXIS, *([None] * (x.ndim - 1)))
         updates[f] = jax.device_put(x, NamedSharding(mesh, spec))
+    sharded = {id(getattr(state, f)) for f in REPLICA_FIELDS}
+    state = jax.tree.map(
+        lambda x: x if id(x) in sharded else jax.device_put(x, repl), state
+    )
     return state.replace(**updates)
 
 

@@ -1,0 +1,127 @@
+"""App-shell + Python-client tier: boot from properties, drive over real HTTP.
+
+Mirrors the reference's main() assembly (KafkaCruiseControlMain.java:26) and the
+``cruise-control-client`` round-trip: the whole system is built from a properties
+dict against the fake backend, served on an ephemeral port, and exercised through
+:class:`CruiseControlClient` — every endpoint at least once, including an async
+rebalance that polls its User-Task-ID to completion.
+"""
+
+import time
+
+import pytest
+
+from cruise_control_tpu.app import CruiseControlTpuApp, load_properties
+from cruise_control_tpu.backend import FakeClusterBackend
+from cruise_control_tpu.client import ClientError, CruiseControlClient
+from cruise_control_tpu.core.config_defs import cruise_control_config
+
+WINDOW_MS = 60_000
+
+
+def seeded_backend(num_brokers=4, partitions=12):
+    backend = FakeClusterBackend()
+    for b in range(num_brokers):
+        backend.add_broker(b, rack=str(b % 2))
+    for p in range(partitions):
+        backend.create_partition(
+            ("T", p), [p % 2, (p % 2 + 1) % num_brokers], load=[1.5, 4e3, 6e3, 3e4]
+        )
+    return backend
+
+
+@pytest.fixture(scope="module")
+def served_app():
+    props = {
+        "partition.metrics.window.ms": WINDOW_MS,
+        "num.partition.metrics.windows": 4,
+        "metric.sampling.interval.ms": 3_600_000,   # manual sampling below
+        "anomaly.detection.interval.ms": 3_600_000,
+        "broker.capacity.config.resolver.class":
+            "cruise_control_tpu.monitor.capacity.StaticCapacityResolver",
+        "sample.store.class":
+            "cruise_control_tpu.monitor.samplestore.NoopSampleStore",
+        "webserver.http.port": 0,                   # ephemeral
+        "min.valid.partition.ratio": 0.5,
+    }
+    app = CruiseControlTpuApp(props, backend=seeded_backend())
+    # the static capacity resolver default is 1.0 per resource; give real numbers
+    from cruise_control_tpu.core.resources import Resource
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+
+    app.monitor.capacity_resolver = StaticCapacityResolver(
+        {Resource.CPU: 100.0, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6, Resource.DISK: 1e7}
+    )
+    now = int(time.time() * 1000)
+    for w in range(6):
+        app.monitor.sample_once(now_ms=now + w * WINDOW_MS)
+    app.start(serve_http=True)
+    yield app
+    app.stop()
+
+
+@pytest.fixture(scope="module")
+def client(served_app):
+    return CruiseControlClient(f"http://127.0.0.1:{served_app.port}",
+                               poll_timeout_s=600.0)
+
+
+class TestConfig:
+    def test_full_registry_parses_defaults(self):
+        cfg = cruise_control_config()
+        values = cfg.parse({})
+        assert values["cpu.capacity.threshold"] == 0.7
+        assert values["webserver.http.port"] == 9090
+        assert "num.partition.metrics.windows" in values
+
+    def test_doc_table_covers_every_key(self):
+        cfg = cruise_control_config()
+        table = cfg.doc_table()
+        for name in cfg.names():
+            assert name in table
+
+    def test_properties_file_round_trip(self, tmp_path):
+        p = tmp_path / "cc.properties"
+        p.write_text("webserver.http.port=1234\n# comment\ncpu.capacity.threshold=0.6\n")
+        props = load_properties(str(p))
+        assert props == {"webserver.http.port": "1234", "cpu.capacity.threshold": "0.6"}
+
+
+class TestClientRoundTrip:
+    def test_state_and_load(self, client):
+        state = client.state()
+        assert "MonitorState" in state
+        load = client.load()
+        assert load["brokers"]
+
+    def test_partition_load_and_cluster_state(self, client):
+        pl = client.partition_load(resource="DISK", entries=5)
+        assert len(pl["records"]) <= 5
+        ks = client.kafka_cluster_state()
+        assert ks
+
+    def test_rebalance_round_trip(self, client):
+        out = client.rebalance(dryrun=True)
+        assert out  # completed task payload
+        props = client.proposals()
+        assert "proposals" in props
+
+    def test_pause_resume_sampling(self, client):
+        client.pause_sampling("test")
+        client.resume_sampling("test")
+
+    def test_add_remove_broker_dryrun(self, client):
+        client.add_broker([3], dryrun=True)
+        client.remove_broker([3], dryrun=True)
+
+    def test_user_tasks_listing(self, client):
+        tasks = client.user_tasks()
+        assert "userTasks" in tasks
+
+    def test_permissions_and_review_board(self, client):
+        assert client.permissions() is not None
+        assert client.review_board() is not None
+
+    def test_unknown_endpoint_raises(self, client):
+        with pytest.raises(ClientError):
+            client._get("not_an_endpoint")

@@ -1,0 +1,287 @@
+"""Auxiliary subsystem tests: sensors, wire serde, container awareness,
+fetcher pool, JWT/trusted-proxy security, Prometheus sampler.
+
+These are the SURVEY §2 components outside the solver hot path: each test
+drives the public surface the way its consumer does (observability export,
+reporter→sampler transport, cgroup-quota'd CPU correction, concurrent sample
+fetching, token-authenticated requests, Prometheus query_range adaptation).
+"""
+
+import json
+import time
+
+import pytest
+
+from cruise_control_tpu.api.security import AuthenticationError, Role
+from cruise_control_tpu.api.security_providers import (
+    JwtSecurityProvider,
+    TrustedProxySecurityProvider,
+    encode_jwt,
+)
+from cruise_control_tpu.backend.base import RawMetric
+from cruise_control_tpu.core.sensors import SensorRegistry
+from cruise_control_tpu.monitor.container import (
+    adjust_cpu_util,
+    container_cpu_limit_cores,
+    effective_cores,
+)
+from cruise_control_tpu.monitor.fetcher import (
+    DefaultPartitionAssignor,
+    FetcherPool,
+)
+from cruise_control_tpu.monitor.samples import (
+    MetricSampler,
+    PartitionMetricSample,
+    SampleBatch,
+)
+from cruise_control_tpu.monitor.wire import (
+    WireFormatError,
+    deserialize,
+    serialize,
+)
+
+
+class TestSensors:
+    def test_timer_gauge_counter_meter_snapshot(self):
+        reg = SensorRegistry()
+        with reg.timer("A.t").time():
+            pass
+        reg.timer("A.t").update(0.5)
+        reg.gauge("A.g").set(42.0)
+        reg.counter("A.c").inc(3)
+        reg.meter("A.m").mark(2)
+        snap = reg.snapshot()
+        assert snap["timers"]["A.t"]["count"] == 2
+        assert snap["timers"]["A.t"]["max_s"] >= 0.5
+        assert snap["gauges"]["A.g"] == 42.0
+        assert snap["counters"]["A.c"] == 3
+        assert snap["meters"]["A.m"]["total"] == 2
+
+    def test_prefix_filter(self):
+        reg = SensorRegistry()
+        reg.gauge("LoadMonitor.x").set(1)
+        reg.gauge("Executor.y").set(2)
+        snap = reg.snapshot(prefix="LoadMonitor.")
+        assert list(snap["gauges"]) == ["LoadMonitor.x"]
+
+    def test_timer_percentiles(self):
+        reg = SensorRegistry()
+        for v in (0.1, 0.2, 0.3, 0.4, 1.0):
+            reg.timer("t").update(v)
+        s = reg.timer("t").snapshot()
+        assert 0.2 <= s["p50_s"] <= 0.4
+        assert s["p95_s"] == 1.0
+
+
+class TestWireSerde:
+    def _metrics(self):
+        return [
+            RawMetric("ALL_TOPIC_BYTES_IN", "BROKER", 3, 1234.5, 1_700_000_000_000),
+            RawMetric("TOPIC_BYTES_IN", "TOPIC", 3, 99.0, 1_700_000_000_000, topic="T1"),
+            RawMetric("PARTITION_SIZE", "PARTITION", 4, 5.5, 1_700_000_000_123,
+                      topic="T1", partition=7),
+            RawMetric("BROKER_CPU_UTIL", "BROKER", 0, 0.66, 1_700_000_000_456),
+        ]
+
+    def test_round_trip(self):
+        payload = serialize(self._metrics())
+        out = deserialize(payload)
+        assert out == self._metrics()
+
+    def test_unknown_name_rejected_on_serialize(self):
+        bad = [RawMetric("NOT_A_METRIC", "BROKER", 0, 1.0, 0)]
+        with pytest.raises(WireFormatError):
+            serialize(bad)
+
+    def test_truncated_payload_raises(self):
+        payload = serialize(self._metrics())
+        with pytest.raises(WireFormatError):
+            deserialize(payload[: len(payload) // 2])
+
+    def test_newer_version_records_are_skipped(self):
+        payload = bytearray(serialize(self._metrics()[:1]))
+        payload[4] = 99  # bump the first record's version byte past ours
+        assert deserialize(bytes(payload)) == []
+
+
+class TestContainerAwareness:
+    def test_v2_quota(self, tmp_path):
+        p = tmp_path / "cpu.max"
+        p.write_text("200000 100000\n")
+        assert container_cpu_limit_cores(v2_path=str(p)) == 2.0
+
+    def test_v2_unlimited(self, tmp_path):
+        p = tmp_path / "cpu.max"
+        p.write_text("max 100000\n")
+        assert container_cpu_limit_cores(
+            v2_path=str(p),
+            v1_quota_path=str(tmp_path / "nope"),
+            v1_period_path=str(tmp_path / "nope2"),
+        ) is None
+
+    def test_v1_quota(self, tmp_path):
+        q = tmp_path / "quota"; q.write_text("150000")
+        per = tmp_path / "period"; per.write_text("100000")
+        assert container_cpu_limit_cores(
+            v2_path=str(tmp_path / "missing"),
+            v1_quota_path=str(q), v1_period_path=str(per),
+        ) == 1.5
+
+    def test_adjust_cpu_util_scales_to_allowance(self, tmp_path):
+        p = tmp_path / "cpu.max"
+        p.write_text("200000 100000")    # 2 cores allowed
+        # 0.1 of a 16-core host == 0.8 of the 2-core allowance
+        v = adjust_cpu_util(0.1, host_cores=16, v2_path=str(p))
+        assert abs(v - 0.8) < 1e-9
+        assert effective_cores(host_cores=16, v2_path=str(p)) == 2.0
+
+
+class _RecordingSampler(MetricSampler):
+    def __init__(self, partitions, calls):
+        self.partitions = partitions
+        self.calls = calls
+
+    def get_samples(self, from_ms, to_ms):
+        self.calls.append(1)
+        samples = [
+            PartitionMetricSample(tp, 0, to_ms, (1.0, 2.0)) for tp in self.partitions
+        ]
+        return SampleBatch(samples, [])
+
+
+class TestFetcherPool:
+    def test_assignor_keeps_topics_whole(self):
+        partitions = [("A", i) for i in range(6)] + [("B", i) for i in range(3)] + [("C", 0)]
+        buckets = DefaultPartitionAssignor().assign(partitions, 3)
+        for bucket in buckets:
+            topics = {tp[0] for tp in bucket}
+            for t in topics:
+                whole = [tp for tp in partitions if tp[0] == t]
+                assert all(tp in bucket for tp in whole), f"topic {t} split"
+
+    def test_pool_fans_out_and_merges(self):
+        partitions = [("A", 0), ("A", 1), ("B", 0), ("C", 0)]
+        calls = []
+        pool = FetcherPool(
+            sampler_factory=lambda: _RecordingSampler(partitions, calls),
+            list_partitions=lambda: partitions,
+            num_fetchers=2,
+        )
+        batch = pool.get_samples(0, 1000)
+        # each partition delivered exactly once despite every sampler seeing all
+        assert sorted(s.tp for s in batch.partition_samples) == sorted(partitions)
+        assert len(calls) == 2
+        pool.close()
+
+
+class TestJwtProvider:
+    SECRET = "s3cr3t"
+
+    def test_valid_token(self):
+        token = encode_jwt({"sub": "alice", "role": "ADMIN",
+                            "exp": time.time() + 60}, self.SECRET)
+        prov = JwtSecurityProvider(self.SECRET)
+        user, role = prov.authenticate({"Authorization": f"Bearer {token}"})
+        assert user == "alice" and role is Role.ADMIN
+
+    def test_expired_token_rejected(self):
+        token = encode_jwt({"sub": "a", "exp": time.time() - 5}, self.SECRET)
+        with pytest.raises(AuthenticationError):
+            JwtSecurityProvider(self.SECRET).authenticate(
+                {"Authorization": f"Bearer {token}"}
+            )
+
+    def test_bad_signature_rejected(self):
+        token = encode_jwt({"sub": "a"}, "other-secret")
+        with pytest.raises(AuthenticationError):
+            JwtSecurityProvider(self.SECRET).authenticate(
+                {"Authorization": f"Bearer {token}"}
+            )
+
+    def test_audience_enforced(self):
+        good = encode_jwt({"sub": "a", "aud": "cc"}, self.SECRET)
+        bad = encode_jwt({"sub": "a", "aud": "other"}, self.SECRET)
+        prov = JwtSecurityProvider(self.SECRET, expected_audiences=["cc"])
+        prov.authenticate({"Authorization": f"Bearer {good}"})
+        with pytest.raises(AuthenticationError):
+            prov.authenticate({"Authorization": f"Bearer {bad}"})
+
+
+class TestTrustedProxyProvider:
+    def test_proxy_secret_and_forwarded_user(self):
+        prov = TrustedProxySecurityProvider(
+            "proxy-pass", user_roles={"ops": Role.ADMIN}
+        )
+        user, role = prov.authenticate(
+            {"X-Proxy-Secret": "proxy-pass", "X-Forwarded-User": "ops"}
+        )
+        assert user == "ops" and role is Role.ADMIN
+
+    def test_wrong_secret_rejected(self):
+        prov = TrustedProxySecurityProvider("proxy-pass")
+        with pytest.raises(AuthenticationError):
+            prov.authenticate({"X-Proxy-Secret": "x", "X-Forwarded-User": "ops"})
+
+    def test_missing_user_rejected(self):
+        prov = TrustedProxySecurityProvider("proxy-pass")
+        with pytest.raises(AuthenticationError):
+            prov.authenticate({"X-Proxy-Secret": "proxy-pass"})
+
+
+class TestPrometheusSampler:
+    def _fake_prom(self, url, timeout_s):
+        q = url.split("query=")[1].split("&")[0]
+        if "BytesInPerSec" in q and "topic" not in q:
+            result = [
+                {"metric": {"instance": "b0:7071"}, "values": [[1000.0, "5000"]]},
+                {"metric": {"instance": "b1:7071"}, "values": [[1000.0, "7000"]]},
+            ]
+        elif "idle" in q:
+            result = [{"metric": {"instance": "b0:7071"}, "values": [[1000.0, "0.25"]]}]
+        elif "topic" in q:
+            result = [
+                {
+                    "metric": {"instance": "b0:7071", "topic": "T"},
+                    "values": [[1000.0, "1200"]],
+                }
+            ]
+        elif "kafka_log_Log_Size" in q:
+            result = [
+                {
+                    "metric": {"instance": "b0:7071", "topic": "T", "partition": "0"},
+                    "values": [[1000.0, "900"]],
+                }
+            ]
+        else:
+            result = []
+        return {"status": "success", "data": {"result": result}}
+
+    def test_query_range_to_samples(self):
+        from cruise_control_tpu.backend.base import PartitionInfo
+
+        topics = {
+            "T": [PartitionInfo(("T", 0), leader=0, replicas=[0, 1], isr=[0, 1])]
+        }
+        from cruise_control_tpu.monitor.prometheus import PrometheusMetricSampler
+
+        sampler = PrometheusMetricSampler(
+            "http://prom:9090",
+            broker_by_instance={"b0:7071": 0, "b1:7071": 1},
+            describe_topics=lambda: topics,
+            fetch_fn=self._fake_prom,
+        )
+        batch = sampler.get_samples(0, 2_000_000)
+        assert len(batch.partition_samples) >= 1
+        assert {s.tp for s in batch.partition_samples} == {("T", 0)}
+
+    def test_unmapped_instance_skipped(self):
+        from cruise_control_tpu.monitor.prometheus import PrometheusMetricSampler
+
+        sampler = PrometheusMetricSampler(
+            "http://prom:9090",
+            broker_by_instance={},           # nothing mapped
+            describe_topics=lambda: {},
+            fetch_fn=self._fake_prom,
+        )
+        batch = sampler.get_samples(0, 2_000_000)
+        assert len(batch) == 0

@@ -153,3 +153,19 @@ class TestCombinedProposal:
         by_tp = {i.tp: i for infos in backend.describe_topics().values() for i in infos}
         assert set(by_tp[("T", 0)].replicas) == {0, 2}
         assert by_tp[("T", 0)].leader == 2
+
+
+class TestIntraBrokerExecution:
+    def test_logdir_only_moves_execute_via_intra_phase(self):
+        """A logdir-moves map with no matching placement proposal still plans and
+        executes intra-broker tasks (Executor.intraBrokerMoveReplicas :1679)."""
+        backend = FakeClusterBackend()
+        backend.add_broker(0, rack="0", logdirs={"/d1": 1e6, "/d2": 1e6})
+        backend.add_broker(1, rack="1", logdirs={"/d1": 1e6})
+        backend.create_partition(("T", 0), [0, 1], load=[1.0, 10.0, 10.0, 100.0])
+        executor = Executor(backend)
+        summary = executor.execute_proposals(
+            [], logdir_moves={(("T", 0), 0): "/d2"}
+        )
+        assert summary.completed >= 1
+        assert ("logdir", (("T", 0), 0, "/d2")) in backend.admin_log

@@ -236,3 +236,119 @@ class TestSwaps:
         for p in set(rp[valid].tolist()):
             rs = racks[rb[valid & (rp == p)]]
             assert len(set(rs.tolist())) == len(rs), f"partition {p} rack collision"
+
+
+class TestIntraBrokerDiskGoals:
+    """JBOD goals (IntraBrokerDiskCapacityGoal.java / IntraBrokerDiskUsage-
+    DistributionGoal.java): logdir-level rebalancing that never leaves the
+    broker, driving the executor's intra-broker phase and REMOVE_DISKS."""
+
+    LOGDIRS = {"/d1": 100_000.0, "/d2": 100_000.0}
+
+    def _jbod_cluster(self):
+        cluster = fixtures.homogeneous_cluster({0: "0", 1: "1"}, logdirs=self.LOGDIRS)
+        # broker 0: four 30k-disk replicas all on /d1 → 120k > the 80k limit
+        for i in range(4):
+            cluster.create_replica(0, ("T1", i), 0, True, logdir="/d1")
+            cluster.set_replica_load(0, ("T1", i), fixtures.load(1.0, 10.0, 10.0, 30_000.0))
+        # broker 1: one replica, so the inter-broker goals have nothing to fix
+        cluster.create_replica(1, ("T1", 4), 0, True, logdir="/d1")
+        cluster.set_replica_load(1, ("T1", 4), fixtures.load(1.0, 10.0, 10.0, 30_000.0))
+        return cluster
+
+    def _optimize_intra(self, cluster):
+        from cruise_control_tpu.analyzer.proposals import logdir_moves
+
+        state, maps = cluster.to_arrays(pad_replicas_to=8, pad_partitions_to=8, pad_topics_to=2)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        opt = GoalOptimizer(
+            goal_ids=G.INTRA_BROKER_GOALS, hard_ids=(G.INTRA_DISK_CAPACITY,)
+        )
+        final, result = opt.optimize(state, ctx, maps=maps)
+        return state, final, result, maps, logdir_moves(state, final, maps)
+
+    def test_overfull_logdir_drains_to_sibling(self):
+        init, final, result, maps, ld = self._optimize_intra(self._jbod_cluster())
+        # no replica left its broker
+        np.testing.assert_array_equal(
+            np.asarray(init.replica_broker), np.asarray(final.replica_broker)
+        )
+        assert result.violations_after["IntraBrokerDiskCapacityGoal"] == 0
+        # /d1 on broker 0 is under its 80% limit now, the surplus sits on /d2
+        disk_load = np.asarray(A.disk_load(final))
+        d1 = maps.disk_index[(0, "/d1")]
+        d2 = maps.disk_index[(0, "/d2")]
+        assert disk_load[d1] <= 80_000.0 + 1e-3
+        assert disk_load[d2] > 0
+        # the executor receives logdir moves, all to broker 0's /d2
+        assert ld and all(b == 0 and path == "/d2" for (_, b), path in ld.items())
+
+    def test_remove_disks_drains_marked_logdir(self):
+        cluster = self._jbod_cluster()
+        # put /d1 under the limit first so only the removal forces moves
+        cluster.delete_replica(0, ("T1", 2))
+        cluster.delete_replica(0, ("T1", 3))
+        cluster.mark_disk_removed(0, "/d1")
+        from cruise_control_tpu.analyzer.proposals import logdir_moves
+
+        state, maps = cluster.to_arrays(pad_replicas_to=8, pad_partitions_to=8, pad_topics_to=2)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        opt = GoalOptimizer(
+            goal_ids=G.INTRA_BROKER_GOALS, hard_ids=(G.INTRA_DISK_CAPACITY,)
+        )
+        final, result = opt.optimize(state, ctx, maps=maps)
+
+        rd = np.asarray(final.replica_disk)
+        valid = np.asarray(final.replica_valid)
+        d1 = maps.disk_index[(0, "/d1")]
+        assert not ((rd == d1) & valid).any(), "removed logdir must end empty"
+        np.testing.assert_array_equal(
+            np.asarray(state.replica_broker), np.asarray(final.replica_broker)
+        )
+        assert result.violations_after["IntraBrokerDiskCapacityGoal"] == 0
+
+    def test_intra_moves_never_violate_prior_inter_goals(self):
+        """Running the full default list plus the intra goals keeps every
+        inter-broker guarantee (intra moves have zero broker-level deltas)."""
+        cluster = self._jbod_cluster()
+        state, maps = cluster.to_arrays(pad_replicas_to=8, pad_partitions_to=8, pad_topics_to=2)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        opt = GoalOptimizer(
+            goal_ids=tuple(G.DEFAULT_GOAL_ORDER) + G.INTRA_BROKER_GOALS,
+        )
+        final, result = opt.optimize(state, ctx, maps=maps)
+        assert result.violations_after["RackAwareGoal"] == 0
+        assert result.violations_after["IntraBrokerDiskCapacityGoal"] == 0
+
+
+class TestSwapSourceSideAcceptance:
+    """A swap's source broker can GAIN load in resources other than the one the
+    swap round optimizes; prior hard goals must veto that (the reference's
+    CapacityGoal checks both endpoints for REPLICA_SWAP)."""
+
+    def test_swap_cannot_push_source_over_prior_cpu_limit(self):
+        from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+
+        cluster = fixtures.homogeneous_cluster({0: "0", 1: "1"})
+        # broker 0: near the CPU limit (0.7·100), disk-heavy — wants disk swaps
+        cluster.create_replica(0, ("T1", 0), 0, True)
+        cluster.set_replica_load(0, ("T1", 0), fixtures.load(10.0, 10.0, 10.0, 120_000.0))
+        cluster.create_replica(0, ("T1", 1), 0, True)
+        cluster.set_replica_load(0, ("T1", 1), fixtures.load(55.0, 10.0, 10.0, 10_000.0))
+        # broker 1: disk-light but CPU-heavy replicas — tempting swap partners
+        cluster.create_replica(1, ("T1", 2), 0, True)
+        cluster.set_replica_load(1, ("T1", 2), fixtures.load(40.0, 10.0, 10.0, 5_000.0))
+        cluster.create_replica(1, ("T1", 3), 0, True)
+        cluster.set_replica_load(1, ("T1", 3), fixtures.load(20.0, 10.0, 10.0, 8_000.0))
+
+        state, maps = cluster.to_arrays(pad_replicas_to=8, pad_partitions_to=8, pad_topics_to=2)
+        constraint = BalancingConstraint.default(max_replicas_per_broker=2)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers, constraint=constraint)
+        opt = GoalOptimizer(
+            goal_ids=(G.REPLICA_CAPACITY, G.CPU_CAPACITY, G.DISK_USAGE_DIST)
+        )
+        final, result = opt.optimize(state, ctx, maps=maps)
+
+        cpu = np.asarray(A.broker_load(final))[:, Resource.CPU]
+        assert cpu[0] <= 70.0 + 1e-3, f"swap pushed source over the CPU limit: {cpu}"
+        assert result.violations_after["CpuCapacityGoal"] == 0
