@@ -202,6 +202,103 @@ def accept_leader_bytes_in(state, ctx, snap, moves, eff):
     return ok_dst & ok_src
 
 
+def accept_preferred_leader(state, ctx, snap, moves, eff):
+    """PreferredLeaderElectionGoal (:37): once optimized, leadership may only sit
+    on (or transfer to) the partition's replica-list head while that head lives
+    on an alive broker."""
+    is_lead_move = moves.kind == KIND_LEADERSHIP
+    p = eff.partition
+    pref = snap.preferred_leader[p]
+    pref_safe = jnp.maximum(pref, 0)
+    pref_ok = (pref >= 0) & state.broker_alive[state.replica_broker[pref_safe]]
+    ok = ~is_lead_move | ~pref_ok | (moves.dst_replica == pref)
+    return ok
+
+
+def accept_rack_aware_dist(state, ctx, snap, moves, eff):
+    """RackAwareDistributionGoal: a replica move must keep every rack at or
+    under its fair share ceil(RF / alive racks) of the partition's replicas;
+    swaps check BOTH directions (the partner arriving at the source can push the
+    source rack over its fair share for the partner's partition)."""
+    from cruise_control_tpu.analyzer.context import rack_fair_share
+
+    kind = moves.kind
+    fair = rack_fair_share(state, snap, eff.partition)
+    src_rack = state.broker_rack[eff.src_broker]
+    dst_rack = state.broker_rack[eff.dst_broker]
+    occ_dst = snap.rack_counts[eff.partition, dst_rack] - (src_rack == dst_rack).astype(jnp.int32)
+    occ_src = snap.rack_counts[eff.partition, src_rack]
+    ok_fwd = (occ_dst + 1 <= fair) | (occ_dst + 1 <= occ_src - 1)
+
+    partner = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+    p2 = state.replica_partition[partner]
+    fair2 = rack_fair_share(state, snap, p2)
+    occ_bwd = snap.rack_counts[p2, src_rack] - (dst_rack == src_rack).astype(jnp.int32)
+    occ_bwd_src = snap.rack_counts[p2, dst_rack]
+    ok_bwd = (occ_bwd + 1 <= fair2) | (occ_bwd + 1 <= occ_bwd_src - 1)
+
+    return jnp.where(
+        kind == KIND_LEADERSHIP,
+        True,
+        jnp.where(kind == KIND_SWAP, ok_fwd & ok_bwd, ok_fwd),
+    )
+
+
+def accept_broker_set_aware(state, ctx, snap, moves, eff):
+    """BrokerSetAwareGoal: replica moves/swaps stay within the topic's broker set
+    (topics without a mapping are unconstrained)."""
+    kind = moves.kind
+    topic = state.partition_topic[eff.partition]
+    want = ctx.broker_set_of_topic[topic]
+    have_dst = ctx.broker_set_of_broker[eff.dst_broker]
+    ok = (want < 0) | (have_dst == want)
+    partner = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+    p2 = state.partition_topic[state.replica_partition[partner]]
+    want2 = ctx.broker_set_of_topic[p2]
+    have_src = ctx.broker_set_of_broker[eff.src_broker]
+    ok_swap = ok & ((want2 < 0) | (have_src == want2))
+    return jnp.where(
+        kind == KIND_LEADERSHIP, True, jnp.where(kind == KIND_SWAP, ok_swap, ok)
+    )
+
+
+def accept_topic_leader_dist(state, ctx, snap, moves, eff):
+    """TopicLeaderReplicaDistributionGoal: whichever endpoint gains a leader of
+    a topic stays within that topic's band or below the other endpoint's count.
+
+    Per-topic, not net: a swap of two leaders has zero net leader delta yet the
+    destination gains a leader of the outgoing replica's topic and the source
+    gains one of the partner's topic — each checked against its own topic."""
+    if not snap.enable_heavy:
+        return jnp.ones(moves.num_slots, bool)
+    from cruise_control_tpu.analyzer.context import topic_leader_upper
+
+    kind = moves.kind
+    is_swap = kind == KIND_SWAP
+    r = jnp.where(eff.valid, moves.replica, 0)
+    r_leads = snap.is_leader[r]
+    partner = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+    partner_leads = snap.is_leader[partner] & (moves.dst_replica >= 0)
+
+    t_out = state.partition_topic[eff.partition]
+    t_in = state.partition_topic[state.replica_partition[partner]]
+    lt = snap.topic_leader_counts
+    lt_up = topic_leader_upper(state, ctx, snap)
+
+    # destination gains a leader of t_out on replica-carrying moves (leader
+    # replica travels) and on leadership transfers
+    dst_gains = jnp.where(kind == KIND_LEADERSHIP, True, r_leads)
+    after_dst = lt[eff.dst_broker, t_out] + 1
+    ok_dst = (after_dst <= lt_up[t_out]) | (after_dst <= lt[eff.src_broker, t_out] - 1)
+
+    # source gains a leader of t_in only when a swap's partner leads
+    src_gains = is_swap & partner_leads
+    after_src = lt[eff.src_broker, t_in] + 1
+    ok_src = (after_src <= lt_up[t_in]) | (after_src <= lt[eff.dst_broker, t_in] - 1)
+
+    return (~dst_gains | ok_dst) & (~src_gains | ok_src)
+
+
 def accept_intra_disk_capacity(state, ctx, snap, moves, eff):
     """IntraBrokerDiskCapacityGoal: an intra-broker logdir move must land under
     the destination disk's capacity threshold.  Inter-broker moves and swaps
@@ -241,6 +338,11 @@ _KERNELS = {
     G.LEADER_BYTES_IN_DIST: accept_leader_bytes_in,
     G.INTRA_DISK_CAPACITY: accept_intra_disk_capacity,
     G.INTRA_DISK_USAGE_DIST: accept_intra_disk_dist,
+    G.PREFERRED_LEADER_ELECTION: accept_preferred_leader,
+    G.RACK_AWARE_DISTRIBUTION: accept_rack_aware_dist,
+    G.TOPIC_LEADER_DIST: accept_topic_leader_dist,
+    G.BROKER_SET_AWARE: accept_broker_set_aware,
+    G.KAFKA_ASSIGNER_RACK: accept_rack_aware,
 }
 
 
@@ -268,6 +370,12 @@ def accept_all(
         ok = ok & jnp.where(
             prior_mask[gid], accept_resource_dist(state, ctx, snap, moves, eff, res), True
         )
+    # kafka-assigner disk goal shares ResourceDistributionGoal's DISK acceptance
+    ok = ok & jnp.where(
+        prior_mask[G.KAFKA_ASSIGNER_DISK],
+        accept_resource_dist(state, ctx, snap, moves, eff, Resource.DISK),
+        True,
+    )
     return ok
 
 
@@ -303,11 +411,27 @@ def move_dst_matrix(
 
     ok = jnp.ones((S, B), bool)
 
-    # RackAwareGoal
+    # RackAwareGoal (and the kafka-assigner strict variant)
     dst_rack = state.broker_rack[None, :]       # [1, B]
     src_rack = state.broker_rack[src][:, None]  # [S, 1]
     occ = snap.rack_counts[p][:, state.broker_rack] - (src_rack == dst_rack).astype(jnp.int32)
-    ok &= jnp.where(prior_mask[G.RACK_AWARE], occ == 0, True)
+    strict_rack = prior_mask[G.RACK_AWARE] | prior_mask[G.KAFKA_ASSIGNER_RACK]
+    ok &= jnp.where(strict_rack, occ == 0, True)
+
+    # RackAwareDistributionGoal (relaxed): dst rack stays within its fair share
+    from cruise_control_tpu.analyzer.context import rack_fair_share
+
+    fair = rack_fair_share(state, snap, p)[:, None]
+    occ_src = snap.rack_counts[p][jnp.arange(S), state.broker_rack[src]][:, None]
+    rad_ok = (occ + 1 <= fair) | (occ + 1 <= occ_src - 1)
+    ok &= jnp.where(prior_mask[G.RACK_AWARE_DISTRIBUTION], rad_ok, True)
+
+    # BrokerSetAwareGoal: destination stays inside the topic's broker set
+    want = ctx.broker_set_of_topic[topic][:, None]
+    have = ctx.broker_set_of_broker[None, :]
+    ok &= jnp.where(
+        prior_mask[G.BROKER_SET_AWARE], (want < 0) | (have == want), True
+    )
 
     # MinTopicLeadersPerBrokerGoal — source-side only (leader leaving a broker)
     if snap.enable_heavy:
@@ -455,6 +579,23 @@ def leadership_target_ok(
     lbi_after = snap.leader_nw_in[b] + nw_in
     lbi_ok = (lbi_after <= snap.leader_nw_in_upper) | (lbi_after <= snap.leader_nw_in[leader_b])
     ok &= jnp.where(prior_mask[G.LEADER_BYTES_IN_DIST], lbi_ok, True)
+
+    # PreferredLeaderElectionGoal: only the replica-list head may take leadership
+    pref = snap.preferred_leader[p]
+    pref_safe = jnp.maximum(pref, 0)
+    pref_alive = (pref >= 0) & state.broker_alive[state.replica_broker[pref_safe]]
+    is_pref = jnp.arange(R, dtype=jnp.int32) == pref
+    ok &= jnp.where(prior_mask[G.PREFERRED_LEADER_ELECTION], ~pref_alive | is_pref, True)
+
+    # TopicLeaderReplicaDistributionGoal: gaining broker stays within its band
+    if snap.enable_heavy:
+        from cruise_control_tpu.analyzer.context import topic_leader_upper
+
+        lt = snap.topic_leader_counts
+        lt_up = topic_leader_upper(state, ctx, snap)
+        after = lt[b, topic] + 1
+        tld_ok = (after <= lt_up[topic]) | (after <= lt[leader_b, topic] - 1)
+        ok &= jnp.where(prior_mask[G.TOPIC_LEADER_DIST], tld_ok, True)
 
     return ok & state.replica_valid & (cur_leader >= 0)
 

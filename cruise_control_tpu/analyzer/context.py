@@ -42,6 +42,10 @@ class GoalContext:
     #: (``topics.with.min.leaders.per.broker``); all-False disables the goal.
     min_leader_topics: jax.Array
     fast_mode: jax.Array                   # bool scalar
+    #: i32[B]/[T] broker-set membership for BrokerSetAwareGoal
+    #: (brokerSets.json / BrokerSetResolver); -1 = unassigned/unconstrained.
+    broker_set_of_broker: jax.Array = None
+    broker_set_of_topic: jax.Array = None
     #: candidate actions nominated per broker per round (static: shapes depend on
     #: it).  Larger values admit more moves per round at more memory per round —
     #: the depth of the reference's per-broker SortedReplicas candidate walk that
@@ -62,6 +66,8 @@ class GoalContext:
         min_leader_topic_ids: Sequence[int] = (),
         fast_mode: bool = False,
         top_k: int = 8,
+        broker_set_of_broker: Sequence[int] = (),
+        broker_set_of_topic: Sequence[int] = (),
     ) -> "GoalContext":
         et = jnp.zeros(num_topics, bool)
         if excluded_topic_ids:
@@ -85,6 +91,16 @@ class GoalContext:
             min_leader_topics=ml,
             fast_mode=jnp.asarray(fast_mode),
             top_k=top_k,
+            broker_set_of_broker=(
+                jnp.asarray(list(broker_set_of_broker), jnp.int32)
+                if broker_set_of_broker
+                else jnp.full(num_brokers, -1, jnp.int32)
+            ),
+            broker_set_of_topic=(
+                jnp.asarray(list(broker_set_of_topic), jnp.int32)
+                if broker_set_of_topic
+                else jnp.full(num_topics, -1, jnp.int32)
+            ),
         )
 
 
@@ -124,6 +140,10 @@ class Snapshot:
     disk_upper: jax.Array = None       # f32[D] intra-broker balance band upper
     disk_usable: jax.Array = None      # bool[D] alive and not marked for removal
     disk_replica_counts: jax.Array = None  # i32[D] replicas assigned per logdir
+
+    #: i32[P] "preferred" leader = the partition's lowest-index valid replica
+    #: (the reference's replica-list head, PreferredLeaderElectionGoal.java:37)
+    preferred_leader: jax.Array = None
 
     # heavy [B, T] tensors — None unless enable_heavy
     topic_counts: Optional[jax.Array] = None       # i32[B, T]
@@ -218,6 +238,16 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         d_lower = jnp.zeros((0,), jnp.float32)
         d_upper = jnp.zeros((0,), jnp.float32)
 
+    # preferred leader = lowest replica index per partition (replica-list head)
+    idxR = jnp.arange(state.num_replicas, dtype=jnp.int32)
+    bigR = jnp.int32(2**30)
+    pref = jax.ops.segment_min(
+        jnp.where(state.replica_valid, idxR, bigR),
+        state.replica_partition,
+        num_segments=state.num_partitions,
+    )
+    preferred = jnp.where(pref < bigR, pref, -1)
+
     topic_counts = topic_band = topic_leader_counts = None
     if enable_heavy:
         topic_counts = A.topic_replica_counts_by_broker(state)
@@ -265,6 +295,7 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         disk_upper=d_upper,
         disk_usable=d_usable,
         disk_replica_counts=d_counts,
+        preferred_leader=preferred,
         topic_counts=topic_counts,
         topic_band=topic_band,
         topic_leader_counts=topic_leader_counts,
@@ -275,6 +306,40 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
 # ---------------------------------------------------------------------------
 # Small shared kernels.
 # ---------------------------------------------------------------------------
+
+
+def topic_leader_upper(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> jax.Array:
+    """i32[T]: per-topic leader-count upper band (TopicLeaderReplicaDistribution-
+    Goal; reuses the topic-replica balance knobs).  Single source of truth shared
+    by the proposer round, acceptance kernels, and the violation counter —
+    divergent copies would make the optimizer oscillate."""
+    lt = snap.topic_leader_counts
+    c = ctx.constraint
+    alive_n = jnp.maximum(state.broker_alive.sum(), 1).astype(jnp.float32)
+    avg_lt = lt.sum(axis=0).astype(jnp.float32) / alive_n
+    pct = (c.topic_replica_balance_threshold - 1.0) * c.balance_margin
+    gap = jnp.clip(
+        jnp.ceil(avg_lt * pct).astype(jnp.int32),
+        c.topic_replica_balance_min_gap,
+        c.topic_replica_balance_max_gap,
+    )
+    return jnp.floor(avg_lt).astype(jnp.int32) + gap
+
+
+def rack_fair_share(state: ClusterArrays, snap: Snapshot, partition: jax.Array) -> jax.Array:
+    """i32[...]: ceil(RF / alive racks) per given partition ids — the relaxed
+    rack-awareness bound (RackAwareDistributionGoal).  Shared by the round,
+    the acceptance kernels, and the violation counter."""
+    n_racks_avail = jnp.maximum(
+        jax.ops.segment_max(
+            state.broker_alive.astype(jnp.int32),
+            state.broker_rack,
+            num_segments=state.num_racks,
+        ).sum(),
+        1,
+    )
+    rf_p = jnp.maximum(snap.rack_counts[partition].sum(axis=-1), 1)
+    return jnp.ceil(rf_p.astype(jnp.float32) / n_racks_avail).astype(jnp.int32)
 
 
 def segment_argmax(

@@ -601,6 +601,150 @@ def intra_disk_dist_round(
     )
 
 
+# -- optional / auxiliary goals ----------------------------------------------------
+
+
+def preferred_leader_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
+    """PreferredLeaderElectionGoal (:37): transfer leadership back to each
+    partition's replica-list head (used by demote flows and kafka's PLE)."""
+    from cruise_control_tpu.analyzer.acceptance import leadership_target_ok
+    from cruise_control_tpu.analyzer.moves import KIND_LEADERSHIP
+    from cruise_control_tpu.analyzer.proposers import topk_segment_argmax
+
+    B = state.num_brokers
+    k = ctx.top_k
+    pref = snap.preferred_leader
+    p_of_r = state.replica_partition
+    pref_of_r = pref[p_of_r]
+    target_ok = leadership_target_ok(state, ctx, snap, prior_mask)
+    pref_safe = jnp.maximum(pref_of_r, 0)
+    # the head must be electable: alive AND leadership-movable (not demoted /
+    # excluded-for-leadership / offline) AND prior-goal acceptable
+    pref_usable = (
+        (pref_of_r >= 0)
+        & state.broker_alive[state.replica_broker[pref_safe]]
+        & snap.leader_movable[pref_safe]
+        & target_ok[pref_safe]
+    )
+    idx = jnp.arange(state.num_replicas, dtype=jnp.int32)
+    wrong = snap.is_leader & pref_usable & (pref_of_r != idx) & snap.leader_movable
+    src_need = jax.ops.segment_sum(
+        wrong.astype(jnp.float32), state.replica_broker, num_segments=B
+    )
+    cands = topk_segment_argmax(
+        jnp.zeros(state.num_replicas, jnp.float32), state.replica_broker, B, wrong, k
+    )
+    cand = cands.reshape(-1)
+    valid = cand >= 0
+    cand_safe = jnp.where(valid, cand, 0)
+    dst_rep = pref[state.replica_partition[cand_safe]]
+    dst_rep_safe = jnp.maximum(dst_rep, 0)
+    replica = jnp.where(valid & (dst_rep >= 0), cand_safe, -1)
+    src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
+    return MoveBatch(
+        kind=jnp.asarray(KIND_LEADERSHIP, jnp.int32),
+        replica=replica,
+        dst_broker=jnp.where(replica >= 0, state.replica_broker[dst_rep_safe], -1),
+        dst_replica=jnp.where(replica >= 0, dst_rep, -1),
+        score=jnp.where(replica >= 0, src_need[src_of_slot], 0.0),
+    )
+
+
+def rack_dist_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
+    """RackAwareDistributionGoal: even out each partition's replicas across the
+    alive racks (fair share = ceil(RF / alive racks))."""
+    from cruise_control_tpu.analyzer.context import rack_fair_share
+
+    p_of_r = state.replica_partition
+    fair = rack_fair_share(state, snap, jnp.arange(state.num_partitions))
+    rack_of_r = state.broker_rack[state.replica_broker]
+    occ_r = snap.rack_counts[p_of_r, rack_of_r]
+    viol = state.replica_valid & (occ_r > fair[p_of_r])
+    src_need = jax.ops.segment_sum(
+        viol.astype(jnp.float32), state.replica_broker, num_segments=state.num_brokers
+    )
+
+    def dst_fn(cand: jax.Array):
+        p = state.replica_partition[cand]
+        src_rack = state.broker_rack[state.replica_broker[cand]]
+        occ = snap.rack_counts[p][:, state.broker_rack]
+        occ = occ - (src_rack[:, None] == state.broker_rack[None, :]).astype(jnp.int32)
+        elig = occ + 1 <= fair[p][:, None]
+        score = -occ.astype(jnp.float32) - 1e-3 * _counts_f(snap)[None, :]
+        return elig, score
+
+    return shed_round(
+        state, ctx, snap, prior_mask, salt,
+        src_need=src_need,
+        cand_score=jnp.zeros(state.num_replicas, jnp.float32),
+        cand_ok=viol & snap.movable,
+        dst_fn=dst_fn,
+    )
+
+
+def topic_leader_dist_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
+    """TopicLeaderReplicaDistributionGoal: shed per-topic leadership from
+    brokers above the per-topic band onto followers below it."""
+    if not snap.enable_heavy:
+        return MoveBatch.empty(ctx.top_k * state.num_brokers, 1)
+    from cruise_control_tpu.analyzer.context import topic_leader_upper
+
+    lt = snap.topic_leader_counts
+    lt_up = topic_leader_upper(state, ctx, snap)
+    topic = state.partition_topic[state.replica_partition]
+    fb = state.replica_broker
+    r_excess = (lt[fb, topic] - lt_up[topic]).astype(jnp.float32)
+    src_need = jnp.where(
+        state.broker_alive, jnp.maximum(lt - lt_up[None, :], 0).max(axis=1), 0
+    ).astype(jnp.float32)
+    fits = lt[fb, topic] + 1 <= lt_up[topic]
+    return leadership_shed_round(
+        state, ctx, snap, prior_mask, salt,
+        src_need=src_need,
+        leader_score=r_excess,
+        leader_ok=snap.movable & (r_excess > 0),
+        follower_score=-lt[fb, topic].astype(jnp.float32),
+        follower_ok=fits,
+    )
+
+
+def broker_set_round(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
+    """BrokerSetAwareGoal: move replicas back inside their topic's broker set."""
+    topic = state.partition_topic[state.replica_partition]
+    want = ctx.broker_set_of_topic[topic]
+    have = ctx.broker_set_of_broker[state.replica_broker]
+    viol = state.replica_valid & (want >= 0) & (have != want)
+    src_need = jax.ops.segment_sum(
+        viol.astype(jnp.float32), state.replica_broker, num_segments=state.num_brokers
+    )
+
+    def dst_fn(cand: jax.Array):
+        want_c = ctx.broker_set_of_topic[topic[cand]]
+        elig = ctx.broker_set_of_broker[None, :] == want_c[:, None]
+        score = _bcast(-snap.util_pct.max(axis=-1), cand.shape[0])
+        return elig, score
+
+    return shed_round(
+        state, ctx, snap, prior_mask, salt,
+        src_need=src_need,
+        cand_score=jnp.zeros(state.num_replicas, jnp.float32),
+        cand_ok=viol & snap.movable,
+        dst_fn=dst_fn,
+    )
+
+
 # -- registry ----------------------------------------------------------------------
 
 GOAL_ROUNDS: Dict[int, Tuple[RoundFn, ...]] = {
@@ -654,4 +798,16 @@ GOAL_ROUNDS: Dict[int, Tuple[RoundFn, ...]] = {
     G.LEADER_BYTES_IN_DIST: (leader_bytes_in_round,),
     G.INTRA_DISK_CAPACITY: (intra_disk_capacity_round,),
     G.INTRA_DISK_USAGE_DIST: (intra_disk_dist_round,),
+    G.PREFERRED_LEADER_ELECTION: (preferred_leader_round,),
+    G.RACK_AWARE_DISTRIBUTION: (rack_dist_round,),
+    G.TOPIC_LEADER_DIST: (topic_leader_dist_round,),
+    G.BROKER_SET_AWARE: (broker_set_round,),
+    # kafka-assigner compatibility mode: the strict rack goal runs the rack
+    # round; the disk goal runs the disk-distribution rounds (swap-inclusive)
+    G.KAFKA_ASSIGNER_RACK: (rack_round,),
+    G.KAFKA_ASSIGNER_DISK: (
+        _dist_shed_round(Resource.DISK),
+        _dist_fill_round(Resource.DISK),
+        _dist_swap_round(Resource.DISK),
+    ),
 }

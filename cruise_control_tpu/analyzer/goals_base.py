@@ -48,7 +48,14 @@ LEADER_BYTES_IN_DIST = 15
 # REMOVE_DISKS and explicit goal lists, IntraBrokerDiskCapacityGoal.java)
 INTRA_DISK_CAPACITY = 16
 INTRA_DISK_USAGE_DIST = 17
-NUM_GOALS = 18
+# optional / auxiliary goals (present in the reference, never in default.goals)
+PREFERRED_LEADER_ELECTION = 18   # PreferredLeaderElectionGoal.java:37
+RACK_AWARE_DISTRIBUTION = 19     # RackAwareDistributionGoal.java (relaxed rack aware)
+TOPIC_LEADER_DIST = 20           # TopicLeaderReplicaDistributionGoal.java
+BROKER_SET_AWARE = 21            # BrokerSetAwareGoal.java
+KAFKA_ASSIGNER_RACK = 22         # kafkaassigner/KafkaAssignerEvenRackAwareGoal.java
+KAFKA_ASSIGNER_DISK = 23         # kafkaassigner/KafkaAssignerDiskUsageDistributionGoal.java
+NUM_GOALS = 24
 
 GOAL_NAMES: Tuple[str, ...] = (
     "RackAwareGoal",
@@ -69,11 +76,17 @@ GOAL_NAMES: Tuple[str, ...] = (
     "LeaderBytesInDistributionGoal",
     "IntraBrokerDiskCapacityGoal",
     "IntraBrokerDiskUsageDistributionGoal",
+    "PreferredLeaderElectionGoal",
+    "RackAwareDistributionGoal",
+    "TopicLeaderReplicaDistributionGoal",
+    "BrokerSetAwareGoal",
+    "KafkaAssignerEvenRackAwareGoal",
+    "KafkaAssignerDiskUsageDistributionGoal",
 )
 GOAL_ID_BY_NAME: Dict[str, int] = {n: i for i, n in enumerate(GOAL_NAMES)}
 
 #: Goals needing [B, T] tensors — skipped at scale unless explicitly enabled.
-HEAVY_GOALS: Tuple[int, ...] = (MIN_TOPIC_LEADERS, TOPIC_REPLICA_DIST)
+HEAVY_GOALS: Tuple[int, ...] = (MIN_TOPIC_LEADERS, TOPIC_REPLICA_DIST, TOPIC_LEADER_DIST)
 
 #: Default ``hard.goals`` (AnalyzerConfig.java:337-344).
 HARD_GOALS: Tuple[int, ...] = (
@@ -193,6 +206,52 @@ def violations_all(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> ja
         deficit = jnp.maximum(0, need - snap.topic_leader_counts) * ctx.min_leader_topics[None, :]
         deficit = jnp.where(alive[:, None], deficit, 0)
         out = out.at[MIN_TOPIC_LEADERS].set(deficit.sum())
+
+        # TopicLeaderReplicaDistributionGoal: per-topic leader counts within a
+        # band around the per-broker average (reuses the topic-replica balance
+        # thresholds; the reference has dedicated topic.leader.* knobs)
+        from cruise_control_tpu.analyzer.context import topic_leader_upper
+
+        lt = snap.topic_leader_counts
+        lt_up = topic_leader_upper(state, ctx, snap)
+        out = out.at[TOPIC_LEADER_DIST].set(
+            ((lt > lt_up[None, :]) & alive[:, None]).sum()
+        )
+
+    # PreferredLeaderElectionGoal: partitions not led by their replica-list head
+    # (when the head sits on an alive broker)
+    pref = snap.preferred_leader
+    pref_safe = jnp.maximum(pref, 0)
+    pref_ok = (pref >= 0) & state.broker_alive[state.replica_broker[pref_safe]]
+    out = out.at[PREFERRED_LEADER_ELECTION].set(
+        (pref_ok & (state.partition_leader != pref)).sum()
+    )
+
+    # RackAwareDistributionGoal: replicas spread across racks as evenly as the
+    # alive-rack count allows (relaxed rack awareness — ceil(RF / racks) per rack)
+    from cruise_control_tpu.analyzer.context import rack_fair_share
+
+    rf_p = jax.ops.segment_sum(
+        state.replica_valid.astype(jnp.int32),
+        state.replica_partition,
+        num_segments=state.num_partitions,
+    )
+    fair = rack_fair_share(state, snap, jnp.arange(state.num_partitions))
+    out = out.at[RACK_AWARE_DISTRIBUTION].set(
+        ((snap.rack_counts.max(axis=1) > fair) & (rf_p > 0)).sum()
+    )
+
+    # BrokerSetAwareGoal: replicas outside their topic's broker set
+    r_topic = state.partition_topic[state.replica_partition]
+    want_set = ctx.broker_set_of_topic[r_topic]
+    have_set = ctx.broker_set_of_broker[state.replica_broker]
+    out = out.at[BROKER_SET_AWARE].set(
+        (state.replica_valid & (want_set >= 0) & (have_set != want_set)).sum()
+    )
+
+    # kafka-assigner compatibility goals share their base goals' criteria
+    out = out.at[KAFKA_ASSIGNER_RACK].set(out[RACK_AWARE])
+    out = out.at[KAFKA_ASSIGNER_DISK].set(out[DISK_USAGE_DIST])
 
     if state.num_disks > 0:
         usable = snap.disk_usable

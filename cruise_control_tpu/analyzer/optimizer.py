@@ -40,8 +40,12 @@ from cruise_control_tpu.analyzer.goal_rounds import (
 )
 from cruise_control_tpu.analyzer.moves import admit, apply_moves, move_effects
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal, diff as diff_proposals
+from cruise_control_tpu.model import arrays as A
 from cruise_control_tpu.model import stats as S
 from cruise_control_tpu.model.arrays import ClusterArrays
+
+
+FAST_MODE_MAX_ROUNDS = 64
 
 
 class OptimizationFailure(Exception):
@@ -99,11 +103,91 @@ class GoalReport:
 
 @dataclasses.dataclass
 class ProvisionRecommendation:
-    """UNDER/OVER_PROVISIONED verdict (ProvisionResponse.java)."""
+    """UNDER/OVER_PROVISIONED verdict with numeric sizing
+    (ProvisionResponse.java / ProvisionRecommendation.java)."""
 
-    status: str                      # "UNDER_PROVISIONED" | "RIGHT_SIZED"
+    status: str                      # "UNDER_PROVISIONED" | "RIGHT_SIZED" | "OVER_PROVISIONED"
     violated_hard_goals: List[str]
     message: str
+    num_brokers_to_add: int = 0
+    num_brokers_to_remove: int = 0
+
+
+#: AnalyzerConfig.java defaults: overprovisioned.min.brokers (:*),
+#: overprovisioned.min.extra.racks, overprovisioned.max.replicas.per.broker —
+#: the floor below which a cluster is never called over-provisioned.
+OVERPROVISIONED_MIN_BROKERS = 3
+OVERPROVISIONED_MIN_EXTRA_RACKS = 2
+OVERPROVISIONED_MAX_REPLICAS_PER_BROKER = 1500
+
+
+def provision_verdict(
+    state: ClusterArrays, ctx, violated_hard: List[str]
+) -> ProvisionRecommendation:
+    """Size the cluster against its load (the aggregate of the per-goal
+    ProvisionResponse stream the reference folds in AbstractGoal.java:120-123).
+
+    UNDER: hard goals unsatisfied — recommend adding the broker deficit implied
+    by the most constrained resource.  OVER: every hard goal satisfied AND the
+    load would fit on materially fewer brokers (respecting replication factor,
+    the max-replicas floor and the minimum broker/rack margins) — recommend
+    removing the surplus.  Otherwise RIGHT_SIZED.
+    """
+    import numpy as np
+
+    alive = np.asarray(state.broker_alive)
+    n_alive = max(int(alive.sum()), 1)
+    bload = np.asarray(A.broker_load(state))
+    cap = np.asarray(state.broker_capacity)
+    thr = np.asarray(ctx.constraint.resource_capacity_threshold)
+    total_load = bload[alive].sum(axis=0)
+    usable_per_broker = (cap[alive].mean(axis=0) if alive.any() else cap.mean(axis=0)) * thr
+    needed_by_res = int(
+        np.ceil((total_load / np.maximum(usable_per_broker, 1e-9)).max())
+    )
+    valid = np.asarray(state.replica_valid)
+    rf_max = 0
+    if valid.any():
+        counts = np.bincount(
+            np.asarray(state.replica_partition)[valid], minlength=state.num_partitions
+        )
+        rf_max = int(counts.max())
+    needed_by_count = int(
+        np.ceil(valid.sum() / OVERPROVISIONED_MAX_REPLICAS_PER_BROKER)
+    )
+    needed = max(needed_by_res, needed_by_count, rf_max, OVERPROVISIONED_MIN_BROKERS)
+
+    if violated_hard:
+        deficit = max(needed - n_alive, 1)
+        return ProvisionRecommendation(
+            status="UNDER_PROVISIONED",
+            violated_hard_goals=violated_hard,
+            message=(
+                f"Add at least {deficit} broker(s): hard goals unsatisfiable: "
+                + ", ".join(violated_hard)
+            ),
+            num_brokers_to_add=deficit,
+        )
+
+    racks_in_use = len(
+        set(np.asarray(state.broker_rack)[alive].tolist())
+    )
+    surplus = n_alive - needed
+    if surplus > 0 and racks_in_use >= rf_max + OVERPROVISIONED_MIN_EXTRA_RACKS:
+        return ProvisionRecommendation(
+            status="OVER_PROVISIONED",
+            violated_hard_goals=[],
+            message=(
+                f"Remove up to {surplus} broker(s): the load fits on {needed} "
+                f"of {n_alive} alive brokers under the capacity thresholds."
+            ),
+            num_brokers_to_remove=surplus,
+        )
+    return ProvisionRecommendation(
+        status="RIGHT_SIZED",
+        violated_hard_goals=[],
+        message="Cluster is right-sized for the configured hard goals.",
+    )
 
 
 @dataclasses.dataclass
@@ -219,12 +303,22 @@ class GoalOptimizer:
         maps=None,
         raise_on_hard_failure: bool = False,
     ) -> Tuple[ClusterArrays, OptimizerResult]:
+        from cruise_control_tpu.core.sensors import PROPOSAL_COMPUTATION_TIMER, REGISTRY
+
         t0 = time.monotonic()
         heavy = self.enable_heavy_goals
         initial = state
         viol0 = _violations(state, ctx, enable_heavy=heavy)
         stats_before = S.cluster_model_stats(state)
         no_prior = _mask_of(())
+
+        # fast mode (OptimizationOptions.fastMode / fast.mode.per.broker.move.
+        # timeout.ms): trade quality for bounded wall-clock by capping the round
+        # count of every phase — the batched analogue of the reference's
+        # per-broker time budget
+        max_rounds = self.max_rounds_per_phase
+        if bool(ctx.fast_mode):
+            max_rounds = min(max_rounds, FAST_MODE_MAX_ROUNDS)
 
         # Pre-phase: self-healing relocation of offline replicas (dead broker/disk).
         # The strict pass bounds cumulative admission by the hard goals (so the
@@ -234,7 +328,7 @@ class GoalOptimizer:
         for fn, amask in ((offline_round, hard_mask), (offline_round_relaxed, no_prior)):
             state, _, _ = _phase(
                 state, ctx, no_prior, amask,
-                round_fn=fn, max_rounds=self.max_rounds_per_phase, enable_heavy=heavy,
+                round_fn=fn, max_rounds=max_rounds, enable_heavy=heavy,
             )
 
         reports: List[GoalReport] = []
@@ -253,7 +347,7 @@ class GoalOptimizer:
                 state, r, m = _phase(
                     state, ctx, prior_mask, admit_mask,
                     round_fn=round_fn,
-                    max_rounds=self.max_rounds_per_phase,
+                    max_rounds=max_rounds,
                     enable_heavy=heavy,
                 )
                 rounds += int(r)
@@ -286,16 +380,7 @@ class GoalOptimizer:
             names[g] for g in self.hard_ids
             if g in self.goal_ids and float(violN[g]) > 0
         ]
-        provision = ProvisionRecommendation(
-            status="UNDER_PROVISIONED" if violated_hard else "RIGHT_SIZED",
-            violated_hard_goals=violated_hard,
-            message=(
-                "Add brokers or capacity: hard goals unsatisfiable: "
-                + ", ".join(violated_hard)
-                if violated_hard
-                else "Cluster is right-sized for the configured hard goals."
-            ),
-        )
+        provision = provision_verdict(state, ctx, violated_hard)
 
         proposals: List[ExecutionProposal] = []
         if maps is not None:
@@ -312,4 +397,5 @@ class GoalOptimizer:
             total_moves=total_moves,
             duration_s=time.monotonic() - t0,
         )
+        REGISTRY.timer(PROPOSAL_COMPUTATION_TIMER).update(result.duration_s)
         return state, result

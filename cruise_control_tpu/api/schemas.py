@@ -1,0 +1,141 @@
+"""Response schemas: the REST surface's typed contract.
+
+Counterpart of the reference's response discipline — ``servlet/response/*`` with
+``@JsonResponseField`` annotations, schema-checked in tests against the OpenAPI
+YAML (``src/main/resources/yaml/``).  Python-idiomatic: each endpoint declares a
+lightweight structural schema; :func:`validate` walks a live response against it
+and raises :class:`SchemaViolation` naming the offending path.  The API test
+tier validates every endpoint's response once, so response-shape regressions
+fail loudly instead of surfacing in clients.
+
+Schema mini-language:
+  type                      — value must be an instance (int also accepts float)
+  {"k": schema, ...}        — dict with required keys (extra keys allowed,
+                              mirroring the reference's additive JSON evolution)
+  {"?k": schema}            — optional key
+  [schema]                  — list of schema
+  (s1, s2)                  — any one of the alternatives
+  None                      — JSON null
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class SchemaViolation(Exception):
+    pass
+
+
+def validate(schema: Any, body: Any, path: str = "$") -> None:
+    """Raise SchemaViolation when ``body`` doesn't match ``schema``."""
+    if schema is None:
+        if body is not None:
+            raise SchemaViolation(f"{path}: expected null, got {type(body).__name__}")
+        return
+    if isinstance(schema, tuple):
+        errors = []
+        for alt in schema:
+            try:
+                validate(alt, body, path)
+                return
+            except SchemaViolation as e:
+                errors.append(str(e))
+        raise SchemaViolation(f"{path}: no alternative matched ({'; '.join(errors)})")
+    if isinstance(schema, type):
+        if schema is float and isinstance(body, int) and not isinstance(body, bool):
+            return
+        if schema is int and isinstance(body, bool):
+            raise SchemaViolation(f"{path}: expected int, got bool")
+        if not isinstance(body, schema):
+            raise SchemaViolation(
+                f"{path}: expected {schema.__name__}, got {type(body).__name__}"
+            )
+        return
+    if isinstance(schema, dict):
+        if not isinstance(body, dict):
+            raise SchemaViolation(f"{path}: expected object, got {type(body).__name__}")
+        for key, sub in schema.items():
+            optional = key.startswith("?")
+            name = key[1:] if optional else key
+            if name not in body:
+                if optional:
+                    continue
+                raise SchemaViolation(f"{path}.{name}: required field missing")
+            validate(sub, body[name], f"{path}.{name}")
+        return
+    if isinstance(schema, list):
+        if not isinstance(body, list):
+            raise SchemaViolation(f"{path}: expected array, got {type(body).__name__}")
+        for i, item in enumerate(body):
+            validate(schema[0], item, f"{path}[{i}]")
+        return
+    raise SchemaViolation(f"{path}: unsupported schema node {schema!r}")
+
+
+_BROKER_LOAD = {
+    "Broker": int,
+    "Host": str,
+    "DiskMB": float,
+    "CpuPct": float,
+    "LeaderNwInRate": float,
+    "FollowerNwInRate": float,
+    "NwOutRate": float,
+    "PnwOutRate": float,
+    "Leaders": int,
+    "Replicas": int,
+    "Alive": bool,
+}
+
+_PROPOSAL = {
+    "topic": str,
+    "partition": int,
+    "oldLeader": (int, None),
+    "oldReplicas": [int],
+    "newReplicas": [int],
+}
+
+_USER_TASK = {
+    "UserTaskId": str,
+    "RequestURL": str,
+    "Status": str,
+    "StartMs": int,
+    "?Progress": [dict],
+}
+
+#: endpoint name (CruiseControlEndPoint.java:16-39) -> response schema
+RESPONSE_SCHEMAS: Dict[str, Any] = {
+    "STATE": {
+        "MonitorState": dict,
+        "ExecutorState": dict,
+        "uptime_s": float,
+        "?AnomalyDetectorState": dict,
+    },
+    "LOAD": {"brokers": [_BROKER_LOAD], "?hosts": [dict]},
+    "PARTITION_LOAD": {"records": [dict], "?resource": str},
+    "PROPOSALS": {
+        "proposals": [_PROPOSAL],
+        "?cached": bool,
+        "?dryrun": bool,
+        "?violations_before": dict,
+        "?violations_after": dict,
+        "?provision": (dict, str),
+        "?balancedness": (float, None),
+    },
+    "KAFKA_CLUSTER_STATE": {"brokers": [dict], "topics": dict},
+    "USER_TASKS": {"userTasks": [_USER_TASK]},
+    "REVIEW_BOARD": {"requestInfo": [dict]},
+    "PERMISSIONS": {"role": str},
+    "BOOTSTRAP": {"samplesLoaded": int, "from": int, "to": int},
+    "TRAIN": {"trained": bool},
+}
+
+
+def validate_endpoint(endpoint: str, body: Any) -> None:
+    """Validate a response body against the endpoint's registered schema.
+
+    Unregistered endpoints pass (schemas are additive, like the reference's
+    OpenAPI coverage)."""
+    schema = RESPONSE_SCHEMAS.get(endpoint.upper())
+    if schema is not None:
+        validate(schema, body, f"$({endpoint})")

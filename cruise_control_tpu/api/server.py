@@ -147,13 +147,57 @@ class CruiseControlApp:
         self.proposal_cache_ttl_s = proposal_cache_ttl_s
         self._proposal_cache: Optional[Tuple[float, dict]] = None
         self._lock = threading.Lock()
+        self._refresher_stop: Optional[threading.Event] = None
+
+    # -- proposal precompute (GoalOptimizer.java:153 run()/ProposalCandidateComputer) --
+
+    def start_proposal_refresher(self, interval_s: float = 30.0) -> None:
+        """Background thread keeping the cached proposals fresh so GET /proposals
+        answers instantly (the reference's precompute scheduler wakes every 30 s,
+        GoalOptimizer.java:67,153)."""
+        if self._refresher_stop is not None:
+            return
+        stop = threading.Event()
+        self._refresher_stop = stop
+
+        def loop() -> None:
+            while not stop.wait(interval_s):
+                with self._lock:
+                    cached = self._proposal_cache
+                if (
+                    cached is not None
+                    and time.monotonic() - cached[0] < self.proposal_cache_ttl_s / 2
+                ):
+                    continue
+                try:
+                    op = self.cc.rebalance(dryrun=True)
+                except Exception:
+                    continue   # monitor not ready yet — retry next tick
+                body = _op_result_json(op)
+                # a stop() issued while the rebalance ran invalidates the write
+                # (a superseding refresher may already be computing fresher data)
+                if stop.is_set():
+                    return
+                with self._lock:
+                    self._proposal_cache = (time.monotonic(), body)
+
+        threading.Thread(target=loop, daemon=True, name="proposal-refresher").start()
+
+    def stop_proposal_refresher(self) -> None:
+        if self._refresher_stop is not None:
+            self._refresher_stop.set()
+            self._refresher_stop = None
 
     # -- GET handlers --------------------------------------------------------
 
     def get_state(self, params) -> Tuple[int, dict]:
+        from cruise_control_tpu.core.sensors import REGISTRY
+
         body = self.cc.state()
         if self.anomaly_manager is not None:
             body["AnomalyDetectorState"] = dataclasses.asdict(self.anomaly_manager.state())
+        # sensor families (Sensors.md): timers/gauges/counters per subsystem
+        body["Sensors"] = REGISTRY.snapshot()
         return 200, body
 
     def get_load(self, params) -> Tuple[int, dict]:
@@ -211,7 +255,11 @@ class CruiseControlApp:
         return 200, {"records": rows[:limit]}
 
     def get_proposals(self, params) -> Tuple[int, dict]:
-        ignore_cache = _qbool(params, "ignore_proposal_cache", False)
+        goal_ids = _goal_ids(params)
+        # the cache (and the background refresher feeding it) holds DEFAULT-goal
+        # proposals only; a custom goal list must bypass it — the reference
+        # likewise ignores the cached result for non-default goals
+        ignore_cache = _qbool(params, "ignore_proposal_cache", False) or goal_ids is not None
         with self._lock:
             cached = self._proposal_cache
             if (
@@ -220,10 +268,11 @@ class CruiseControlApp:
                 and time.monotonic() - cached[0] < self.proposal_cache_ttl_s
             ):
                 return 200, {**cached[1], "cached": True}
-        op = self.cc.rebalance(dryrun=True, goal_ids=_goal_ids(params))
+        op = self.cc.rebalance(dryrun=True, goal_ids=goal_ids)
         body = _op_result_json(op)
-        with self._lock:
-            self._proposal_cache = (time.monotonic(), body)
+        if goal_ids is None:
+            with self._lock:
+                self._proposal_cache = (time.monotonic(), body)
         return 200, {**body, "cached": False}
 
     def get_kafka_cluster_state(self, params) -> Tuple[int, dict]:

@@ -185,12 +185,18 @@ class CruiseControlTpuApp:
             v = cfg.get(key)
             return (v / 1000.0) if v is not None else interval
 
+        self.provisioner: Provisioner = cfg.get_configured_instance(
+            "provisioner.class", Provisioner
+        )
         detectors = [
             (
                 GoalViolationDetector(
                     self.cruise_control,
                     detection_goal_ids=_goal_ids(
                         cfg.get("anomaly.detection.goals"), G.DEFAULT_GOAL_ORDER
+                    ),
+                    provisioner=(
+                        self.provisioner if cfg.get("provisioner.enable") else None
                     ),
                 ),
                 _iv("goal.violation.detection.interval.ms"),
@@ -222,9 +228,6 @@ class CruiseControlTpuApp:
         self.anomaly_manager = AnomalyDetectorManager(
             self.cruise_control, notifier, detectors
         )
-        self.provisioner: Provisioner = cfg.get_configured_instance(
-            "provisioner.class", Provisioner
-        )
         self.app = CruiseControlApp(
             self.cruise_control,
             anomaly_manager=self.anomaly_manager,
@@ -254,6 +257,7 @@ class CruiseControlTpuApp:
 
         self._sampling_thread = threading.Thread(target=_sampling_loop, daemon=True)
         self._sampling_thread.start()
+        self.app.start_proposal_refresher()
         if serve_http:
             self._server = make_server(
                 self.app,
@@ -264,6 +268,7 @@ class CruiseControlTpuApp:
 
     def stop(self) -> None:
         self._stop.set()
+        self.app.stop_proposal_refresher()
         if self._server is not None:
             self._server.shutdown()
         self.anomaly_manager.shutdown()

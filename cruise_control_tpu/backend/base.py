@@ -42,6 +42,8 @@ class PartitionInfo:
     leader: Optional[int]             # broker id; None when leaderless
     replicas: Tuple[int, ...]         # ordered broker ids (preferred leader first)
     isr: Tuple[int, ...]
+    #: broker id -> logdir hosting the replica (JBOD; None when not reported)
+    logdir_by_broker: Optional[Dict[int, str]] = None
 
 
 @dataclasses.dataclass(frozen=True)

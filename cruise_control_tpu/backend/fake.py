@@ -102,11 +102,19 @@ class FakeClusterBackend(ClusterBackend):
         DISK] utilization vector."""
         with self._lock:
             reps = list(replicas)
+            # JBOD brokers place new replicas on their first logdir by default
+            # (the broker's own placement policy; moved via alterReplicaLogDirs)
+            logdirs = {
+                b: sorted(self._logdirs[b])[0]
+                for b in reps
+                if self._logdirs.get(b)
+            }
             self._partitions[tp] = _Partition(
                 tp=tp,
                 replicas=reps,
                 leader=leader if leader is not None else reps[0],
                 load=np.asarray(load, np.float64),
+                logdir_by_broker=logdirs,
             )
 
     def kill_broker(self, broker_id: int) -> None:
@@ -152,7 +160,10 @@ class FakeClusterBackend(ClusterBackend):
             for tp, p in self._partitions.items():
                 isr = tuple(r for r in p.replicas if self._brokers[r].alive)
                 out.setdefault(tp[0], []).append(
-                    PartitionInfo(tp=tp, leader=p.leader, replicas=tuple(p.replicas), isr=isr)
+                    PartitionInfo(
+                        tp=tp, leader=p.leader, replicas=tuple(p.replicas), isr=isr,
+                        logdir_by_broker=dict(p.logdir_by_broker) or None,
+                    )
                 )
             for infos in out.values():
                 infos.sort(key=lambda i: i.tp[1])
@@ -266,6 +277,13 @@ class FakeClusterBackend(ClusterBackend):
                 if p.leader not in p.replicas:
                     alive = [b for b in p.replicas if self._brokers[b].alive]
                     p.leader = alive[0] if alive else None
+                # logdir assignments follow the replica set: arriving JBOD
+                # brokers place on their first logdir, departed entries drop
+                p.logdir_by_broker = {
+                    b: p.logdir_by_broker.get(b) or sorted(self._logdirs[b])[0]
+                    for b in p.replicas
+                    if self._logdirs.get(b)
+                }
                 done.append(tp)
         for tp in done:
             del self._reassignments[tp]

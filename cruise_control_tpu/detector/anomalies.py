@@ -214,3 +214,28 @@ class MaintenanceEvent(Anomaly):
     def dedupe_key(self) -> tuple:
         """IdempotenceCache key (MaintenanceEventDetector's dedupe)."""
         return (self.event_type, tuple(sorted(self.broker_ids)))
+
+
+@dataclasses.dataclass
+class PartitionSizeAnomaly(Anomaly):
+    """Partitions whose on-disk size exceeds the configured limit
+    (PartitionSizeAnomalyFinder — oversized partitions hurt reassignment times
+    and broker recovery; surfaced for operator action)."""
+
+    oversized: Dict[tuple, float] = dataclasses.field(default_factory=dict)  # tp -> size
+    size_limit: float = 0.0
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.TOPIC_ANOMALY
+
+    def fix_with(self, cc):
+        # the reference's fix is operator-driven (add partitions to the topic);
+        # surfaced, not self-healed
+        return None
+
+    def description(self) -> str:
+        tps = sorted(self.oversized)[:5]
+        return (
+            f"PartitionSizeAnomaly{{{len(self.oversized)} partitions over "
+            f"{self.size_limit:.0f}, e.g. {tps}}}"
+        )

@@ -59,11 +59,16 @@ class GoalViolationDetector(Detector):
         self,
         cruise_control,
         detection_goal_ids: Sequence[int] = G.DEFAULT_GOAL_ORDER,
+        provisioner=None,
     ) -> None:
         self.cc = cruise_control
         self.detection_goal_ids = tuple(detection_goal_ids)
         self.balancedness_score: float = MAX_BALANCEDNESS_SCORE
         self.last_result = None
+        #: optional Provisioner consulted on non-RIGHT_SIZED verdicts
+        #: (GoalViolationDetector.java:227 rightsize hook)
+        self.provisioner = provisioner
+        self.last_provisioner_result = None
 
     def run(self) -> List[Anomaly]:
         try:
@@ -85,6 +90,11 @@ class GoalViolationDetector(Detector):
         self.balancedness_score = MAX_BALANCEDNESS_SCORE - sum(
             costs[r.goal_id] for r in result.goal_reports if r.violations_before > 0
         )
+        from cruise_control_tpu.core.sensors import BALANCEDNESS_GAUGE, REGISTRY
+
+        REGISTRY.gauge(BALANCEDNESS_GAUGE).set(self.balancedness_score)
+        if self.provisioner is not None and result.provision.status != "RIGHT_SIZED":
+            self.last_provisioner_result = self.provisioner.rightsize(result.provision)
         violated = [
             name for name, v in result.violations_before.items() if v > 0
         ]
@@ -292,3 +302,45 @@ class MaintenanceEventDetector(Detector):
                 self._seen[key] = now
                 out.append(e)
             return out
+
+
+class PartitionSizeAnomalyFinder(Detector):
+    """Flags partitions whose disk footprint exceeds a limit
+    (detector/PartitionSizeAnomalyFinder counterpart): oversized partitions slow
+    every reassignment touching them and skew per-broker balance granularity."""
+
+    name = "PartitionSizeAnomalyFinder"
+
+    def __init__(
+        self,
+        monitor,
+        size_limit: float = 1e9,
+        topic_filter: Optional[Callable[[str], bool]] = None,
+    ) -> None:
+        self.monitor = monitor
+        self.size_limit = size_limit
+        self.topic_filter = topic_filter or (lambda t: True)
+
+    def run(self) -> List[Anomaly]:
+        from cruise_control_tpu.core.resources import Resource
+        from cruise_control_tpu.detector.anomalies import PartitionSizeAnomaly
+        from cruise_control_tpu.monitor.loadmonitor import NotEnoughValidSnapshotsError
+
+        try:
+            model = self.monitor.cluster_model()
+        except NotEnoughValidSnapshotsError:
+            return []
+        oversized: Dict[tuple, float] = {}
+        for tp, broker_id, replica in model.all_replicas():
+            if not replica.is_leader or replica.load is None:
+                continue
+            if not self.topic_filter(tp[0]):
+                continue
+            size = replica.load[Resource.DISK]
+            if size > self.size_limit:
+                oversized[tp] = float(size)
+        if oversized:
+            return [
+                PartitionSizeAnomaly(oversized=oversized, size_limit=self.size_limit)
+            ]
+        return []

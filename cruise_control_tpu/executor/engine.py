@@ -154,7 +154,14 @@ class Executor:
     # -- execution phases ----------------------------------------------------
 
     def _run_execution(self, execution_id: int, planner: ExecutionTaskPlanner) -> None:
+        from cruise_control_tpu.core.sensors import (
+            EXECUTION_STARTED_COUNTER,
+            PROPOSAL_EXECUTION_TIMER,
+            REGISTRY,
+        )
+
         t0 = time.monotonic()
+        REGISTRY.counter(EXECUTION_STARTED_COUNTER).inc()
         throttle = ReplicationThrottleHelper(self.backend, self.throttle_rate_bytes)
         if self._pause_sampling and planner.inter_broker:
             # pause partition sampling while replicas move (:1414)
@@ -178,6 +185,7 @@ class Executor:
                 aborted=counts[TaskState.ABORTED] + counts[TaskState.PENDING],
                 duration_s=time.monotonic() - t0,
             )
+            REGISTRY.timer(PROPOSAL_EXECUTION_TIMER).update(self._last_summary.duration_s)
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
             self.notifier.on_execution_finished(self._last_summary)
 

@@ -209,9 +209,11 @@ class CruiseControl:
         model = self.cluster_model()
         for b, logdir in broker_logdirs:
             model.mark_disk_removed(b, logdir)
+        # capacity goal only: drain exactly the marked logdirs — running the
+        # intra distribution goal here would reshuffle unrelated brokers' disks
         return self._optimize_and_maybe_execute(
             model, dryrun,
-            goal_ids=G.INTRA_BROKER_GOALS,
+            goal_ids=(G.INTRA_DISK_CAPACITY,),
             hard_ids=(G.INTRA_DISK_CAPACITY,),
             **kw,
         )
@@ -324,6 +326,9 @@ class CruiseControl:
             return False
         a, b, c = (float(abs(x)) / total for x in coef)
         self.trained_cpu_weights = CpuModelWeights(a, b, c)
+        # the fitted model replaces the static weights for every subsequent
+        # cluster model (ModelParameters.updateModelCoefficient consumption)
+        self.monitor.set_cpu_model(self.trained_cpu_weights)
         return True
 
     # -- pass-throughs -------------------------------------------------------

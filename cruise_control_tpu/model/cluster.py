@@ -207,6 +207,10 @@ class ClusterModel:
     def partitions(self) -> List[TopicPartition]:
         return sorted(self._partitions)
 
+    def all_replicas(self):
+        """[(tp, broker_id, replica)] — iteration surface for finders/serializers."""
+        return [(tp, b, r) for (tp, b), r in self._replicas.items()]
+
     def replicas_of(self, tp: TopicPartition) -> List[Tuple[int, bool]]:
         """[(broker_id, is_leader)] sorted by replica-list index."""
         return [

@@ -99,18 +99,23 @@ class FetcherPool(MetricSampler):
                 futures.append(self._pool.submit(wrapped.get_samples, from_ms, to_ms))
             psamples, bsamples = [], []
             seen_brokers = set()
-            for fut in concurrent.futures.as_completed(futures, timeout=self.timeout_s):
-                try:
-                    batch = fut.result()
-                except Exception:
-                    continue  # partial batch beats a failed round
-                psamples.extend(batch.partition_samples)
-                # broker samples arrive from every fetcher; dedupe by (broker, ts)
-                for b in batch.broker_samples:
-                    key = (b.broker_id, b.ts_ms)
-                    if key not in seen_brokers:
-                        seen_brokers.add(key)
-                        bsamples.append(b)
+            try:
+                for fut in concurrent.futures.as_completed(futures, timeout=self.timeout_s):
+                    try:
+                        batch = fut.result()
+                    except Exception:
+                        continue  # partial batch beats a failed round
+                    psamples.extend(batch.partition_samples)
+                    # broker samples arrive from every fetcher; dedupe by (broker, ts)
+                    for b in batch.broker_samples:
+                        key = (b.broker_id, b.ts_ms)
+                        if key not in seen_brokers:
+                            seen_brokers.add(key)
+                            bsamples.append(b)
+            except concurrent.futures.TimeoutError:
+                # a hung fetcher forfeits its share; keep what the others got
+                # (the degrade-to-partial contract — never fail the round)
+                pass
         return SampleBatch(psamples, bsamples)
 
     def close(self) -> None:

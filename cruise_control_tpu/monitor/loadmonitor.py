@@ -36,7 +36,11 @@ from cruise_control_tpu.core.metricdef import (
 )
 from cruise_control_tpu.core.resources import NUM_RESOURCES, Resource
 from cruise_control_tpu.model.cluster import BrokerState, ClusterModel
-from cruise_control_tpu.model.model_utils import follower_cpu_from_leader_load
+from cruise_control_tpu.model.model_utils import (
+    DEFAULT_CPU_WEIGHTS,
+    CpuModelWeights,
+    follower_cpu_from_leader_load,
+)
 from cruise_control_tpu.monitor.capacity import BrokerCapacityResolver
 from cruise_control_tpu.monitor.completeness import (
     ModelCompletenessRequirements,
@@ -88,6 +92,9 @@ class LoadMonitor:
         self.capacity_resolver = capacity_resolver
         self.window_ms = window_ms
         self.sample_store = sample_store or NoopSampleStore()
+        #: CPU apportioning weights; replaced by TRAIN when a fitted linear
+        #: model is accepted (ModelParameters.updateModelCoefficient semantics)
+        self.cpu_weights = DEFAULT_CPU_WEIGHTS
         self._partition_agg: MetricSampleAggregator[TopicPartition] = MetricSampleAggregator(
             num_windows, window_ms, min_samples_per_window, COMMON_METRIC_DEF
         )
@@ -127,6 +134,15 @@ class LoadMonitor:
             self._sampling_thread.join(timeout=5)
         self.sampler.close()
         self.sample_store.close()
+
+    def set_cpu_model(self, weights: CpuModelWeights) -> None:
+        """Adopt TRAIN-fitted CPU weights: every subsequent cluster model derives
+        follower CPU and leadership deltas from them (ModelParameters semantics —
+        the trained model replaces the static ModelUtils heuristic)."""
+        self.cpu_weights = weights
+        processor = getattr(self.sampler, "processor", None)
+        if processor is not None:
+            processor.cpu_weights = weights
 
     def _sampling_loop(self, interval_ms: int) -> None:
         while not self._stop.wait(interval_ms / 1000.0):
@@ -223,8 +239,14 @@ class LoadMonitor:
         JBOD logdirs).  Raises :class:`NotEnoughValidSnapshotsError` when the
         completeness requirements cannot be met.
         """
+        from cruise_control_tpu.core.sensors import (
+            CLUSTER_MODEL_CREATION_TIMER,
+            REGISTRY,
+        )
+
         with self.acquire_for_model_generation():
-            return self._cluster_model_locked(from_ms, to_ms, requirements)
+            with REGISTRY.timer(CLUSTER_MODEL_CREATION_TIMER).time():
+                return self._cluster_model_locked(from_ms, to_ms, requirements)
 
     def _cluster_model_locked(
         self,
@@ -253,6 +275,14 @@ class LoadMonitor:
                 f"{requirements.min_required_num_windows}"
             )
         coverage = len(vae.entities) / max(len(all_partitions), 1)
+        from cruise_control_tpu.core.sensors import (
+            MONITORED_PARTITIONS_GAUGE,
+            REGISTRY,
+            VALID_WINDOWS_GAUGE,
+        )
+
+        REGISTRY.gauge(MONITORED_PARTITIONS_GAUGE).set(coverage * 100.0)
+        REGISTRY.gauge(VALID_WINDOWS_GAUGE).set(completeness.num_valid_windows)
         if coverage < requirements.min_monitored_partitions_percentage or not vae.entities:
             raise NotEnoughValidSnapshotsError(
                 f"monitored partition coverage {coverage:.2%} below required "
@@ -261,22 +291,33 @@ class LoadMonitor:
 
         loads = self._reduce_windows(vae)
 
-        model = ClusterModel()
+        model = ClusterModel(cpu_weights=self.cpu_weights)
         logdirs_by_broker = self.backend.describe_logdirs()
+        model_dirs: Dict[int, Dict[str, float]] = {}
         for broker_id, info in sorted(description.brokers.items()):
             cap = self.capacity_resolver.capacity_for(broker_id)
+            dirs = dict(cap.disk_capacity_by_logdir or {})
+            if not dirs:
+                # no per-logdir capacities configured (plain capacity.json) but
+                # the backend reports JBOD logdirs: split the broker's disk
+                # capacity evenly so logdir-level operations stay available
+                reported = logdirs_by_broker.get(broker_id, {})
+                if reported:
+                    per = cap.capacity.get(Resource.DISK, 0.0) / max(len(reported), 1)
+                    dirs = {path: per for path in reported}
+            model_dirs[broker_id] = dirs
             model.create_broker(
                 info.rack,
                 broker_id,
                 cap.capacity,
                 host=info.host,
-                logdirs=cap.disk_capacity_by_logdir,
+                logdirs=dirs,
             )
             if not info.alive:
                 model.set_broker_state(broker_id, BrokerState.DEAD)
             else:
                 for path, d in logdirs_by_broker.get(broker_id, {}).items():
-                    if d.offline and cap.disk_capacity_by_logdir and path in cap.disk_capacity_by_logdir:
+                    if d.offline and path in dirs:
                         model.mark_disk_dead(broker_id, path)
 
         monitored = set(vae.entities)
@@ -286,11 +327,17 @@ class LoadMonitor:
                     continue
                 leader = pinfo.leader
                 load = loads.get(pinfo.tp)
+                dirs_of = pinfo.logdir_by_broker or {}
                 for pos, broker_id in enumerate(pinfo.replicas):
                     if broker_id not in description.brokers:
                         continue
                     is_leader = broker_id == leader
-                    model.create_replica(broker_id, pinfo.tp, pos, is_leader)
+                    logdir = dirs_of.get(broker_id)
+                    if logdir is not None and logdir not in model_dirs.get(broker_id, {}):
+                        logdir = None
+                    model.create_replica(
+                        broker_id, pinfo.tp, pos, is_leader, logdir=logdir
+                    )
                     if load is None:
                         continue
                     cpu, nw_in, nw_out, disk = load
@@ -300,7 +347,9 @@ class LoadMonitor:
                         )
                     else:
                         fcpu = float(
-                            follower_cpu_from_leader_load(nw_in, nw_out, cpu)
+                            follower_cpu_from_leader_load(
+                                nw_in, nw_out, cpu, self.cpu_weights
+                            )
                         )
                         model.set_replica_load(
                             broker_id, pinfo.tp, [fcpu, nw_in, 0.0, disk]

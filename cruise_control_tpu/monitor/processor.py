@@ -32,7 +32,11 @@ _B_IDX = {info.name: info.id for info in BROKER_METRIC_DEF.all()}
 
 
 class MetricsProcessor:
-    """Stateless transformer; one call handles one fetch window."""
+    """One call handles one fetch window; CPU apportioning weights are the only
+    state (replaced by TRAIN via LoadMonitor.set_cpu_model)."""
+
+    def __init__(self, cpu_weights=DEFAULT_CPU_WEIGHTS) -> None:
+        self.cpu_weights = cpu_weights
 
     def process(
         self,
@@ -91,7 +95,7 @@ class MetricsProcessor:
         for tp, leader in leader_of.items():
             group[(leader, tp[0])].append(tp)
 
-        w = DEFAULT_CPU_WEIGHTS
+        w = self.cpu_weights
         psamples: List[PartitionMetricSample] = []
         part_in: Dict[TopicPartition, float] = {}
         for (broker, topic), tps in group.items():

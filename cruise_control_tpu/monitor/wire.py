@@ -8,9 +8,11 @@ the transport topic; samplers deserialize batches back.
 
 Binary layout (little-endian), one record:
 
-    u8  record version        (RECORD_VERSION; readers reject newer majors)
+    u16 record length         (bytes after this field — lets readers SKIP
+                               records of any future layout safely)
+    u8  record version        (RECORD_VERSION)
     u8  scope                 (0=BROKER, 1=TOPIC, 2=PARTITION)
-    u16 metric id             (taxonomy id from core.metricdef.RAW_METRIC_IDS)
+    u16 metric id             (taxonomy id, core.metricdef.RawMetricType)
     i32 broker id
     i64 timestamp ms
     f64 value
@@ -18,10 +20,10 @@ Binary layout (little-endian), one record:
     ..  topic utf-8 bytes
     i32 partition             (PARTITION scope only)
 
-A batch is ``u32 count`` followed by records.  Unknown metric ids are preserved
-through serde (forward compatibility: a newer reporter can feed an older
-sampler, which skips ids it doesn't know — the same guarantee the reference's
-versioned enum gives).
+A batch is ``u32 count`` followed by records.  Forward compatibility: records
+with a newer version or an unknown metric id are skipped by LENGTH — a v2
+layout change can never desync a v1 reader's offsets (the same guarantee the
+reference's versioned wire format gives mixed-version fleets).
 """
 
 from __future__ import annotations
@@ -74,7 +76,8 @@ def serialize(metrics: Iterable[RawMetric]) -> bytes:
             parts.append(topic)
         if m.scope == "PARTITION":
             parts.append(_I32.pack(m.partition if m.partition is not None else -1))
-        records.append(b"".join(parts))
+        body = b"".join(parts)
+        records.append(_U16.pack(len(body)) + body)
     return _U32.pack(len(records)) + b"".join(records)
 
 
@@ -91,31 +94,45 @@ def deserialize(payload: bytes) -> List[RawMetric]:
     off = _U32.size
     out: List[RawMetric] = []
     for _ in range(count):
-        if off + _HEAD.size > len(payload):
+        if off + _U16.size > len(payload):
+            raise WireFormatError("truncated record length")
+        (rlen,) = _U16.unpack_from(payload, off)
+        off += _U16.size
+        if off + rlen > len(payload):
+            raise WireFormatError("truncated record")
+        record = payload[off:off + rlen]
+        off += rlen   # length-prefixed: offsets stay in sync for ANY version
+
+        if len(record) < 1:
+            raise WireFormatError("empty record")
+        version = record[0]
+        if version > RECORD_VERSION:
+            continue  # future layout — skipped whole by length
+        if len(record) < _HEAD.size:
             raise WireFormatError("truncated record header")
-        version, scope_id, metric_id, broker, ts, value = _HEAD.unpack_from(payload, off)
-        off += _HEAD.size
+        version, scope_id, metric_id, broker, ts, value = _HEAD.unpack_from(record, 0)
+        pos = _HEAD.size
         topic = None
         partition = None
         if scope_id >= len(_SCOPES):
             raise WireFormatError(f"unknown scope id {scope_id}")
         scope = _SCOPES[scope_id]
         if scope in ("TOPIC", "PARTITION"):
-            if off + _U16.size > len(payload):
+            if pos + _U16.size > len(record):
                 raise WireFormatError("truncated topic length")
-            (tlen,) = _U16.unpack_from(payload, off)
-            off += _U16.size
-            if off + tlen > len(payload):
+            (tlen,) = _U16.unpack_from(record, pos)
+            pos += _U16.size
+            if pos + tlen > len(record):
                 raise WireFormatError("truncated topic")
-            topic = payload[off:off + tlen].decode()
-            off += tlen
+            topic = record[pos:pos + tlen].decode()
+            pos += tlen
         if scope == "PARTITION":
-            if off + _I32.size > len(payload):
+            if pos + _I32.size > len(record):
                 raise WireFormatError("truncated partition")
-            (partition,) = _I32.unpack_from(payload, off)
-            off += _I32.size
-        if version > RECORD_VERSION or metric_id not in by_id:
-            continue  # forward compatibility: skip, don't fail
+            (partition,) = _I32.unpack_from(record, pos)
+            pos += _I32.size
+        if metric_id not in by_id:
+            continue  # forward compatibility: unknown taxonomy entry
         out.append(
             RawMetric(
                 name=by_id[metric_id], scope=scope, broker_id=broker,

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Start cruise-control-tpu from a properties file
+# (counterpart of kafka-cruise-control-start.sh).
+#
+# Usage: scripts/cruise-control-tpu-start.sh [config/cruisecontrol.properties]
+
+set -euo pipefail
+
+CONFIG="${1:-config/cruisecontrol.properties}"
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+cd "$REPO_ROOT"
+exec python -m cruise_control_tpu --config "$CONFIG"

@@ -24,6 +24,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite's cost is dominated by compiling
+# ~30 solver-phase variants per cluster shape; caching them on disk cuts repeat
+# runs from tens of minutes to minutes.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compilation_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
 
 

@@ -125,3 +125,54 @@ class TestClientRoundTrip:
     def test_unknown_endpoint_raises(self, client):
         with pytest.raises(ClientError):
             client._get("not_an_endpoint")
+
+
+class TestProposalRefresher:
+    def test_background_refresh_makes_proposals_instant(self, served_app, client):
+        """GoalOptimizer.java:153 precompute: after the refresher populates the
+        cache, GET /proposals answers from it (cached=true) without optimizing."""
+        app = served_app.app
+        app._proposal_cache = None
+        app.start_proposal_refresher(interval_s=0.2)
+        try:
+            deadline = time.time() + 120
+            while app._proposal_cache is None and time.time() < deadline:
+                time.sleep(0.2)
+            assert app._proposal_cache is not None, "refresher never filled the cache"
+            t0 = time.time()
+            body = client.proposals()
+            assert body.get("cached") is True
+            assert time.time() - t0 < 2.0
+        finally:
+            app.stop_proposal_refresher()
+
+
+class TestResponseSchemas:
+    """Every GET endpoint's live response validates against its registered
+    schema (the reference's @JsonResponseField / OpenAPI check in servlet tests)."""
+
+    @pytest.mark.parametrize(
+        "endpoint,call",
+        [
+            ("STATE", lambda c: c.state()),
+            ("LOAD", lambda c: c.load()),
+            ("PARTITION_LOAD", lambda c: c.partition_load()),
+            ("PROPOSALS", lambda c: c.proposals()),
+            ("KAFKA_CLUSTER_STATE", lambda c: c.kafka_cluster_state()),
+            ("USER_TASKS", lambda c: c.user_tasks()),
+            ("REVIEW_BOARD", lambda c: c.review_board()),
+            ("PERMISSIONS", lambda c: c.permissions()),
+            ("TRAIN", lambda c: c.train()),
+        ],
+    )
+    def test_get_responses_match_schema(self, client, endpoint, call):
+        from cruise_control_tpu.api.schemas import validate_endpoint
+
+        body = call(client)
+        validate_endpoint(endpoint, body)
+
+    def test_schema_violation_detected(self):
+        from cruise_control_tpu.api.schemas import SchemaViolation, validate_endpoint
+
+        with pytest.raises(SchemaViolation):
+            validate_endpoint("LOAD", {"brokers": [{"Broker": "not-an-int"}]})

@@ -100,8 +100,20 @@ class TestWireSerde:
 
     def test_newer_version_records_are_skipped(self):
         payload = bytearray(serialize(self._metrics()[:1]))
-        payload[4] = 99  # bump the first record's version byte past ours
+        payload[6] = 99  # version byte (after u32 count + u16 record length)
         assert deserialize(bytes(payload)) == []
+
+    def test_newer_version_with_different_layout_cannot_desync(self):
+        """Records are skipped by LENGTH: a future layout change never corrupts
+        the offsets of following v1 records in the same batch."""
+        import struct
+
+        v1 = serialize(self._metrics()[:1])[4:]          # one length-prefixed record
+        weird_body = bytes([99]) + b"\x07" * 33          # v99, arbitrary layout
+        weird = struct.pack("<H", len(weird_body)) + weird_body
+        batch = struct.pack("<I", 2) + weird + v1
+        out = deserialize(batch)
+        assert out == self._metrics()[:1]
 
 
 class TestContainerAwareness:
@@ -285,3 +297,78 @@ class TestPrometheusSampler:
         )
         batch = sampler.get_samples(0, 2_000_000)
         assert len(batch) == 0
+
+
+class TestPartitionSizeAnomalyFinder:
+    def test_oversized_partitions_flagged(self):
+        from cruise_control_tpu.detector.detectors import PartitionSizeAnomalyFinder
+        from tests.test_provision_train import build_cc
+
+        backend, monitor, cc = build_cc()
+        # each leader carries DISK 3e4 per fixture loads
+        finder = PartitionSizeAnomalyFinder(monitor, size_limit=2.5e4)
+        anomalies = finder.run()
+        assert anomalies and anomalies[0].oversized
+        assert all(v > 2.5e4 for v in anomalies[0].oversized.values())
+
+    def test_small_partitions_pass(self):
+        from cruise_control_tpu.detector.detectors import PartitionSizeAnomalyFinder
+        from tests.test_provision_train import build_cc
+
+        backend, monitor, cc = build_cc()
+        finder = PartitionSizeAnomalyFinder(monitor, size_limit=1e9)
+        assert finder.run() == []
+
+
+class TestMetricsReporter:
+    def test_reporter_publishes_and_sampler_consumes(self):
+        from cruise_control_tpu.monitor.reporter import (
+            InMemoryTransport,
+            MetricsReporter,
+            TransportMetricSampler,
+        )
+
+        transport = InMemoryTransport()
+        metrics = [
+            RawMetric("BROKER_CPU_UTIL", "BROKER", 7, 0.42, int(time.time() * 1000)),
+            RawMetric("ALL_TOPIC_BYTES_IN", "BROKER", 7, 5000.0, int(time.time() * 1000)),
+        ]
+        reporter = MetricsReporter(7, transport, collect_fn=lambda: metrics)
+        n = reporter.report_once()
+        assert n == 2 and reporter.batches_published == 1
+
+        sampler = TransportMetricSampler(transport, describe_topics=lambda: {})
+        now = int(time.time() * 1000)
+        batch = sampler.get_samples(now - 60_000, now + 60_000)
+        # broker-scope metrics surface as broker samples
+        assert len(batch.broker_samples) == 1
+        assert batch.broker_samples[0].broker_id == 7
+
+    def test_process_collector_reports_cpu_after_warmup(self):
+        from cruise_control_tpu.monitor.reporter import process_metrics_collector
+
+        collect = process_metrics_collector(0)
+        assert collect() == []           # first tick establishes the baseline
+        sum(i * i for i in range(200_000))  # burn some cpu
+        out = collect()
+        assert len(out) == 1
+        assert out[0].name == "BROKER_CPU_UTIL"
+        assert 0.0 <= out[0].value <= 1.0
+
+
+class TestSensorWiring:
+    def test_hot_paths_populate_the_registry(self):
+        from cruise_control_tpu.core.sensors import (
+            CLUSTER_MODEL_CREATION_TIMER,
+            MONITORED_PARTITIONS_GAUGE,
+            PROPOSAL_COMPUTATION_TIMER,
+            REGISTRY,
+        )
+        from tests.test_provision_train import build_cc
+
+        backend, monitor, cc = build_cc()
+        monitor.cluster_model()
+        cc.rebalance(dryrun=True)
+        assert REGISTRY.timer(CLUSTER_MODEL_CREATION_TIMER).count >= 1
+        assert REGISTRY.timer(PROPOSAL_COMPUTATION_TIMER).count >= 1
+        assert REGISTRY.gauge(MONITORED_PARTITIONS_GAUGE).snapshot() > 0

@@ -1,0 +1,146 @@
+"""TRAIN plumbing + provisioning verdict tests.
+
+* TRAIN (LinearRegressionModelParameters / ModelParameters.java): fitted CPU
+  weights must be CONSUMED — the monitor's next cluster model derives follower
+  CPU and leadership deltas from them, not from the static defaults.
+* Provisioning (ProvisionResponse/ProvisionRecommendation.java): the optimizer
+  sizes the cluster — UNDER with a broker deficit when hard goals fail, OVER
+  with a removable surplus on a near-idle cluster, RIGHT_SIZED otherwise; the
+  goal-violation detector feeds non-RIGHT_SIZED verdicts to the Provisioner.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
+from cruise_control_tpu.analyzer.optimizer import provision_verdict
+from cruise_control_tpu.backend import FakeClusterBackend
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.detector.detectors import GoalViolationDetector
+from cruise_control_tpu.detector.provisioner import BasicProvisioner
+from cruise_control_tpu.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.model.model_utils import (
+    DEFAULT_CPU_WEIGHTS,
+    CpuModelWeights,
+    follower_cpu_from_leader_load,
+)
+from cruise_control_tpu.monitor import (
+    BackendMetricSampler,
+    LoadMonitor,
+    StaticCapacityResolver,
+)
+from cruise_control_tpu.synthetic import SyntheticSpec, generate
+
+CAPACITY = {Resource.CPU: 100.0, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6, Resource.DISK: 1e7}
+WINDOW_MS = 60_000
+
+
+def build_cc(num_brokers=4, partitions=12):
+    backend = FakeClusterBackend()
+    for b in range(num_brokers):
+        backend.add_broker(b, rack=str(b % 2))
+    for p in range(partitions):
+        reps = [p % 2, (p % 2 + 1) % num_brokers]
+        backend.create_partition(("T", p), reps, load=[1.5, 4e3, 6e3, 3e4])
+    monitor = LoadMonitor(
+        backend, BackendMetricSampler(backend), StaticCapacityResolver(CAPACITY),
+        num_windows=4, window_ms=WINDOW_MS,
+    )
+    executor = Executor(backend)
+    cc = CruiseControl(backend, monitor, executor)
+    cc.start()
+    for w in range(6):
+        monitor.sample_once(now_ms=(w + 1) * WINDOW_MS)
+    return backend, monitor, cc
+
+
+class TestTrainPlumbing:
+    def test_fitted_weights_are_consumed_by_next_model(self):
+        backend, monitor, cc = build_cc()
+        fitted = CpuModelWeights(0.5, 0.3, 0.2)
+        monitor.set_cpu_model(fitted)
+        assert monitor.cpu_weights == fitted
+        # the sampler's processor follows too
+        assert monitor.sampler.processor.cpu_weights == fitted
+
+        model = monitor.cluster_model()
+        # find a follower replica and check its CPU matches the fitted formula
+        state, maps = model.to_arrays()
+        lead = np.asarray(
+            state.partition_leader[np.asarray(state.replica_partition)]
+            == np.arange(state.num_replicas)
+        )
+        valid = np.asarray(state.replica_valid)
+        followers = np.nonzero(valid & ~lead)[0]
+        assert len(followers) > 0
+        base = np.asarray(state.base_load)
+        rp = np.asarray(state.replica_partition)
+        ld = np.asarray(state.leadership_delta)
+        f = int(followers[0])
+        p = rp[f]
+        leader_cpu = base[f, Resource.CPU] + ld[p, Resource.CPU]
+        leader_out = ld[p, Resource.NW_OUT]
+        nw_in = base[f, Resource.NW_IN]
+        expect = float(
+            follower_cpu_from_leader_load(nw_in, leader_out, leader_cpu, fitted)
+        )
+        assert base[f, Resource.CPU] == pytest.approx(expect, rel=1e-4)
+        # and it differs from what the static defaults would have produced
+        static = float(
+            follower_cpu_from_leader_load(nw_in, leader_out, leader_cpu, DEFAULT_CPU_WEIGHTS)
+        )
+        assert abs(expect - static) > 1e-9
+
+    def test_train_endpoint_adopts_weights(self):
+        backend, monitor, cc = build_cc()
+        ok = cc.train_cpu_model(0, 10 * WINDOW_MS)
+        assert ok
+        assert monitor.cpu_weights == cc.trained_cpu_weights
+        assert monitor.cpu_weights != DEFAULT_CPU_WEIGHTS
+
+
+class TestProvisionVerdicts:
+    def test_near_idle_cluster_is_over_provisioned(self):
+        spec = SyntheticSpec(
+            num_racks=6, num_brokers=12, num_topics=4, num_partitions=60,
+            replication_factor=2, distribution="uniform", seed=3,
+            mean_cpu=0.01, mean_disk=0.01, mean_nw_in=0.01, mean_nw_out=0.01,
+        )
+        state, maps = generate(spec)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        verdict = provision_verdict(state, ctx, violated_hard=[])
+        assert verdict.status == "OVER_PROVISIONED"
+        assert verdict.num_brokers_to_remove > 0
+
+    def test_busy_cluster_is_right_sized(self):
+        spec = SyntheticSpec(
+            num_racks=6, num_brokers=12, num_topics=4, num_partitions=120,
+            replication_factor=3, distribution="uniform", seed=3,
+            mean_cpu=0.5, mean_disk=0.25, mean_nw_in=0.2, mean_nw_out=0.3,
+        )
+        state, maps = generate(spec)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        verdict = provision_verdict(state, ctx, violated_hard=[])
+        assert verdict.status == "RIGHT_SIZED"
+
+    def test_under_provisioned_reports_broker_deficit(self):
+        spec = SyntheticSpec(
+            num_racks=4, num_brokers=4, num_topics=4, num_partitions=80,
+            replication_factor=3, distribution="uniform", seed=5,
+            mean_cpu=0.4, mean_disk=0.35, mean_nw_in=0.2, mean_nw_out=0.2,
+        )
+        state, maps = generate(spec)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        verdict = provision_verdict(state, ctx, violated_hard=["DiskCapacityGoal"])
+        assert verdict.status == "UNDER_PROVISIONED"
+        assert verdict.num_brokers_to_add >= 1
+
+    def test_detector_feeds_provisioner_on_violation(self):
+        backend, monitor, cc = build_cc()
+        prov = BasicProvisioner()
+        det = GoalViolationDetector(cc, provisioner=prov)
+        det.run()
+        if det.last_result is not None and det.last_result.provision.status != "RIGHT_SIZED":
+            assert prov.history, "provisioner should have been consulted"
+            assert det.last_provisioner_result is not None
