@@ -14,7 +14,14 @@ project's own north-star budget of 30 s for a full rebalance
 """
 
 import json
+import subprocess
+import sys
 import time
+
+#: seconds to wait for the accelerator tunnel before falling back to CPU —
+#: when the tunnel is down, in-process backend init blocks ~25 minutes before
+#: erroring (observed), which would hang the whole benchmark run.
+BACKEND_PROBE_TIMEOUT_S = 180
 
 SCALE = dict(
     num_racks=10,
@@ -57,7 +64,30 @@ def run_once(state, ctx):
     return result
 
 
+def _probe_backend() -> str:
+    """'tpu' when the default backend initializes promptly, else 'cpu'.
+
+    Probes in a subprocess so a dead tunnel can be killed at the timeout
+    instead of blocking this process for its full internal retry budget."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=BACKEND_PROBE_TIMEOUT_S,
+            capture_output=True,
+        )
+        if proc.returncode == 0:
+            return "tpu"
+    except subprocess.TimeoutExpired:
+        pass
+    return "cpu"
+
+
 def main() -> None:
+    platform = _probe_backend()
+    if platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     state, ctx, maps = build()
     run_once(state, ctx)              # compile warm-up
     t0 = time.monotonic()
@@ -77,6 +107,7 @@ def main() -> None:
                 "residual_hard_violations": residual_hard,
                 "total_moves": result.total_moves,
                 "balancedness": round(result.balancedness_score, 4),
+                "platform": platform,
             }
         )
     )

@@ -51,6 +51,12 @@ class GoalContext:
     #: the depth of the reference's per-broker SortedReplicas candidate walk that
     #: runs *in parallel* here.
     top_k: int = struct.field(pytree_node=False, default=8)
+    #: maximum brokers acting as sources/destinations in one round (static).
+    #: Bounds the [slots, brokers] eligibility matrices to
+    #: top_k·max_active_brokers·B — at 10k brokers the uncapped k·B² would be
+    #: tens of GB.  Rounds pick the neediest brokers first; the rest retry in
+    #: later rounds (the while-loop converges the same fixpoint).
+    max_active_brokers: int = struct.field(pytree_node=False, default=256)
 
     @classmethod
     def build(
@@ -66,6 +72,7 @@ class GoalContext:
         min_leader_topic_ids: Sequence[int] = (),
         fast_mode: bool = False,
         top_k: int = 8,
+        max_active_brokers: int = 256,
         broker_set_of_broker: Sequence[int] = (),
         broker_set_of_topic: Sequence[int] = (),
     ) -> "GoalContext":
@@ -91,6 +98,7 @@ class GoalContext:
             min_leader_topics=ml,
             fast_mode=jnp.asarray(fast_mode),
             top_k=top_k,
+            max_active_brokers=max_active_brokers,
             broker_set_of_broker=(
                 jnp.asarray(list(broker_set_of_broker), jnp.int32)
                 if broker_set_of_broker

@@ -243,10 +243,11 @@ def replica_dist_fill(
     dst_need = (lo - snap.replica_counts).astype(jnp.float32)
     donor_keeps = snap.replica_counts[state.replica_broker] - 1 >= lo
 
-    def fit_fn(cand: jax.Array):
+    def fit_fn(cand: jax.Array, rows):
         donor_counts = snap.replica_counts[state.replica_broker[cand]]
-        improves = donor_counts[None, :] >= snap.replica_counts[:, None] + 2
-        src_score = _bcast(donor_counts.astype(jnp.float32), state.num_brokers)
+        dst_counts = snap.replica_counts if rows is None else snap.replica_counts[rows]
+        improves = donor_counts[None, :] >= dst_counts[:, None] + 2
+        src_score = _bcast(donor_counts.astype(jnp.float32), dst_counts.shape[0])
         return improves, src_score
 
     return fill_round(
@@ -350,9 +351,13 @@ def _dist_fill_round(res: int) -> RoundFn:
         src_b = state.replica_broker
         donor_keeps = load <= snap.broker_load[src_b, res] - lower[src_b]
 
-        def fit_fn(cand: jax.Array):
-            fits = snap.broker_load[:, None, res] + load[cand][None, :] <= upper[:, None]
-            src_score = _bcast(snap.util_pct[state.replica_broker[cand], res], state.num_brokers)
+        def fit_fn(cand: jax.Array, rows):
+            dst_load = snap.broker_load[:, res] if rows is None else snap.broker_load[rows, res]
+            dst_upper = upper if rows is None else upper[rows]
+            fits = dst_load[:, None] + load[cand][None, :] <= dst_upper[:, None]
+            src_score = _bcast(
+                snap.util_pct[state.replica_broker[cand], res], dst_load.shape[0]
+            )
             return fits, src_score
 
         return fill_round(

@@ -122,6 +122,19 @@ def _partition_occupancy(
     return occupied | ~unique[:, None]
 
 
+def _cap_sources(need: jax.Array, max_active: int) -> "jax.Array | None":
+    """i32[M] ids of the M neediest brokers, or None when no cap is required.
+
+    Bounds every [slots, B] matrix to top_k·M·B (vs top_k·B² uncapped — tens of
+    GB at 10k brokers).  Brokers beyond the cap retry in later rounds of the
+    same while-loop; the fixpoint is unchanged, only reached in more rounds."""
+    B = need.shape[0]
+    if B <= max_active:
+        return None
+    _, idx = jax.lax.top_k(need, max_active)
+    return idx.astype(jnp.int32)
+
+
 def shed_round(
     state: ClusterArrays,
     ctx: GoalContext,
@@ -136,14 +149,21 @@ def shed_round(
     """One replica-move round pushing load out of violating brokers.
 
     Each active source nominates its top-k candidates; each candidate picks the
-    best destination among those acceptable to every prior goal."""
+    best destination among those acceptable to every prior goal.  At large
+    broker counts only the ``max_active_brokers`` neediest sources act per
+    round (see _cap_sources)."""
     B = state.num_brokers
     k = ctx.top_k
-    S = k * B
     active = src_need > 0
     cands = topk_segment_argmax(cand_score, state.replica_broker, B, cand_ok, k)
-    cand = cands.reshape(-1)                                   # slot = j·B + b
-    src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
+    chosen = _cap_sources(src_need, ctx.max_active_brokers)
+    if chosen is None:
+        cand = cands.reshape(-1)                               # slot = j·B + b
+        src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
+    else:
+        cand = cands[:, chosen].reshape(-1)                    # slot = j·M + m
+        src_of_slot = jnp.tile(chosen, k)
+    S = cand.shape[0]
     valid = active[src_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
 
@@ -180,14 +200,18 @@ def fill_round(
     dst_need: jax.Array,      # f32[B] > 0 ⇒ broker wants load in
     donor_score: jax.Array,   # f32[R] preference among a donor broker's replicas
     donor_ok: jax.Array,      # bool[R]
-    fit_fn: Callable[[jax.Array], Tuple[jax.Array, jax.Array]],
-    # fit_fn(cand i32[B]) -> (fits bool[Bdst, Bsrc], src_score f32[Bdst, Bsrc])
+    fit_fn: Callable[[jax.Array, "jax.Array | None"], Tuple[jax.Array, jax.Array]],
+    # fit_fn(cand i32[B], rows i32[M] | None)
+    #   -> (fits bool[M|B, Bsrc], src_score f32[M|B, Bsrc]); row axis follows
+    #   ``rows`` (destination broker ids) when given, else all brokers
 ) -> MoveBatch:
     """One replica-move round pulling load into under-limit brokers.
 
     Mirrors the move-in direction of ``ResourceDistributionGoal.rebalanceForBroker``
     (:380-435): each needy broker picks its top-k donor brokers; donor replicas are
     rotated across destinations so simultaneous fills don't collide on one replica.
+    At large broker counts only the ``max_active_brokers`` neediest destinations
+    act per round (see _cap_sources).
     """
     B = state.num_brokers
     k = ctx.top_k
@@ -197,36 +221,43 @@ def fill_round(
     cand0 = cands_k[0]
     cand0_safe = jnp.where(cand0 >= 0, cand0, 0)
 
-    fits, sscore = fit_fn(cand0_safe)   # rows = destination, cols = donor broker
+    rows = _cap_sources(dst_need, ctx.max_active_brokers)
+    row_brokers = rows if rows is not None else jnp.arange(B, dtype=jnp.int32)
+    M = row_brokers.shape[0]
+
+    fits, sscore = fit_fn(cand0_safe, rows)   # rows = destination, cols = donor
     cols = jnp.arange(B, dtype=jnp.int32)
     has_cand = (cand0 >= 0)[None, :]
-    not_self = cols[None, :] != cols[:, None]
-    dst_is_ok = (snap.dest_ok & active)[:, None]
+    not_self = cols[None, :] != row_brokers[:, None]
+    dst_is_ok = (snap.dest_ok & active)[row_brokers][:, None]
     fits = fits & has_cand & not_self & dst_is_ok
-    # [donor_slot, dst] acceptance, transposed to [dst, donor]
-    fits = fits & move_dst_matrix(state, ctx, snap, cand0_safe, cand0 >= 0, prior_mask).T
-    fits = fits & ~_partition_occupancy(state, cand0_safe, cand0 >= 0).T
-    sscore = sscore + _cyclic_tiebreak(cols, B, salt)
+    # [donor_slot, dst] acceptance, gathered at the active rows → [M, donor]
+    fits = fits & move_dst_matrix(state, ctx, snap, cand0_safe, cand0 >= 0, prior_mask)[
+        :, row_brokers
+    ].T
+    fits = fits & ~_partition_occupancy(state, cand0_safe, cand0 >= 0)[:, row_brokers].T
+    sscore = sscore + _pair_jitter(row_brokers[:, None], cols[None, :], salt)
     sscore = jnp.where(fits, sscore, NEG)
 
     # pick top-k donor columns per destination row
     replicas, dsts, needs = [], [], []
     n_cands = jnp.maximum((cands_k >= 0).sum(axis=0), 1).astype(jnp.int32)  # per donor
+    rows_idx = jnp.arange(M, dtype=jnp.int32)
     masked = sscore
     for j in range(k):
         donor = jnp.argmax(masked, axis=1).astype(jnp.int32)
         found = jnp.take_along_axis(masked, donor[:, None], axis=1)[:, 0] > NEG / 2
-        masked = masked.at[cols, donor].set(NEG)
+        masked = masked.at[rows_idx, donor].set(NEG)
         # rotate which of the donor's top candidates this destination takes, so
         # two destinations sharing a donor usually receive different replicas;
         # modulo the donor's actual candidate count (cands_k is -1-padded) so a
         # short donor still always offers its first candidate
-        rot = (jnp.arange(B, dtype=jnp.int32) + j + jnp.asarray(salt, jnp.int32)) % n_cands[donor]
+        rot = (row_brokers + j + jnp.asarray(salt, jnp.int32)) % n_cands[donor]
         r_j = cands_k[rot, donor]
-        ok = active & found & (r_j >= 0)
+        ok = active[row_brokers] & found & (r_j >= 0)
         replicas.append(jnp.where(ok, r_j, -1))
-        dsts.append(jnp.where(ok, cols, -1))
-        needs.append(jnp.where(ok, dst_need, 0.0))
+        dsts.append(jnp.where(ok, row_brokers, -1))
+        needs.append(jnp.where(ok, dst_need[row_brokers], 0.0))
     replica = jnp.concatenate(replicas)
     dstv = jnp.concatenate(dsts)
     need = jnp.concatenate(needs)
@@ -234,7 +265,7 @@ def fill_round(
     # The donor columns were vetted with each donor's TOP candidate; rotated
     # replicas must re-pass prior-goal acceptance and partition occupancy for
     # their specific destination (exact per-(slot, dst) gather).
-    K = k * B
+    K = k * M
     slot_valid = replica >= 0
     r_safe = jnp.where(slot_valid, replica, 0)
     d_safe = jnp.where(slot_valid, dstv, 0)
@@ -380,10 +411,15 @@ def swap_round(
     partner_safe = jnp.where(partner_valid, partner, 0)
     p_in = state.replica_partition[partner_safe]
 
-    # top-k outgoing replicas per active source
+    # top-k outgoing replicas per active source (neediest sources when capped)
     cands = topk_segment_argmax(out_score, state.replica_broker, B, out_ok, k)
-    cand = cands.reshape(-1)
-    src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
+    chosen = _cap_sources(src_need, ctx.max_active_brokers)
+    if chosen is None:
+        cand = cands.reshape(-1)
+        src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
+    else:
+        cand = cands[:, chosen].reshape(-1)
+        src_of_slot = jnp.tile(chosen, k)
     valid = active[src_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
     p_out = state.replica_partition[cand_safe]
@@ -442,13 +478,18 @@ def intra_disk_round(
     """
     D = state.num_disks
     k = ctx.top_k
-    S = k * D
     on_disk = state.replica_disk >= 0
     seg = jnp.where(on_disk, state.replica_disk, D)
     active = src_need > 0
     cands = topk_segment_argmax(cand_score, seg, D, cand_ok & on_disk, k)
-    cand = cands.reshape(-1)
-    src_disk_of_slot = jnp.tile(jnp.arange(D, dtype=jnp.int32), k)
+    chosen = _cap_sources(src_need, ctx.max_active_brokers)
+    if chosen is None:
+        cand = cands.reshape(-1)
+        src_disk_of_slot = jnp.tile(jnp.arange(D, dtype=jnp.int32), k)
+    else:
+        cand = cands[:, chosen].reshape(-1)
+        src_disk_of_slot = jnp.tile(chosen, k)
+    S = cand.shape[0]
     valid = active[src_disk_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
 

@@ -187,3 +187,26 @@ class TestFastMode:
         for r in result.goal_reports:
             # rounds accumulates over a goal's round types; each type is capped
             assert r.rounds <= FAST_MODE_MAX_ROUNDS * 4
+
+
+class TestSourceCapping:
+    def test_capped_rounds_reach_the_same_fixpoint(self):
+        """max_active_brokers bounds per-round matrices; the while-loop still
+        converges to zero hard violations, just over more rounds."""
+        from cruise_control_tpu.synthetic import SyntheticSpec, generate
+
+        spec = SyntheticSpec(
+            num_racks=4, num_brokers=16, num_topics=8, num_partitions=400,
+            replication_factor=3, skew_brokers=4, seed=21,
+            mean_disk=0.2, mean_nw_in=0.15,
+        )
+        state, maps = generate(spec)
+        ctx = GoalContext.build(
+            state.num_topics, state.num_brokers, max_active_brokers=4
+        )
+        opt = GoalOptimizer(enable_heavy_goals=True)
+        final, result = opt.optimize(state, ctx)
+        assert not result.violated_hard_goals, result.violations_after
+        ctx_full = GoalContext.build(state.num_topics, state.num_brokers)
+        _, result_full = opt.optimize(state, ctx_full)
+        assert not result_full.violated_hard_goals
