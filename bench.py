@@ -65,29 +65,44 @@ def run_once(state, ctx):
 
 
 def _probe_backend() -> str:
-    """'tpu' when the default backend initializes promptly, else 'cpu'.
+    """The default backend's platform ('tpu' / 'cpu' / …), 'cpu' when dead.
 
     Probes in a subprocess so a dead tunnel can be killed at the timeout
-    instead of blocking this process for its full internal retry budget."""
+    instead of blocking this process for its full internal retry budget; the
+    probe prints the actual platform so a CPU-only machine is never labeled
+    'tpu' in the benchmark JSON."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
             timeout=BACKEND_PROBE_TIMEOUT_S,
             capture_output=True,
+            text=True,
         )
         if proc.returncode == 0:
-            return "tpu"
+            platform = proc.stdout.strip().splitlines()[-1].strip().lower()
+            # the tunneled accelerator registers as the experimental 'axon'
+            # platform but is a TPU chip
+            return "tpu" if platform == "axon" else platform
     except subprocess.TimeoutExpired:
         pass
     return "cpu"
 
 
-def main() -> None:
+def ensure_live_backend() -> str:
+    """Probe the default backend; force the CPU platform when it's dead.
+
+    Shared by bench.py / bench_scale.py / __graft_entry__.py so the dead-tunnel
+    fallback lives in one place.  Returns the platform that will be used."""
     platform = _probe_backend()
     if platform == "cpu":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    return platform
+
+
+def main() -> None:
+    platform = ensure_live_backend()
     state, ctx, maps = build()
     run_once(state, ctx)              # compile warm-up
     t0 = time.monotonic()

@@ -27,6 +27,12 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # dead-tunnel guard: fall back to CPU instead of blocking ~25 min in
+        # in-process backend init (shared bench.py helper)
+        from bench import ensure_live_backend
+
+        ensure_live_backend()
 
     from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
     from cruise_control_tpu.analyzer import goals_base as G

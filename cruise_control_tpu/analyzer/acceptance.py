@@ -391,17 +391,29 @@ def move_dst_matrix(
     cand: jax.Array,        # i32[S] candidate replica per slot (clamped to valid idx)
     cand_valid: jax.Array,  # bool[S]
     prior_mask: jax.Array,  # bool[NUM_GOALS]
+    dst_brokers: "jax.Array | None" = None,   # i32[M] restricts columns to these ids
 ) -> jax.Array:
-    """bool[S, B]: would every prior goal accept moving ``cand[s]`` to broker b?
+    """bool[S, B|M]: would every prior goal accept moving ``cand[s]`` to the
+    column's broker?
 
     The per-slot acceptance kernels above all factor into (slot attrs, destination
     attrs), so each prior goal contributes one broadcast comparison.  Proposers AND
     this into destination eligibility, guaranteeing a proposed move is pre-accepted
     — the vectorized form of the reference's "try the next candidate destination"
     walk.  Slots are replica moves only (swap eligibility stays per-slot).
+
+    ``dst_brokers`` restricts the destination columns so capped fill rounds stay
+    at [S, M] instead of [S, B] — at 10k brokers the difference between an 80 MB
+    and a 2 MB eligibility matrix per prior-goal term.
     """
     S = cand.shape[0]
     B = state.num_brokers
+    db = dst_brokers
+    # gb: restrict a per-broker-axis array to the dst_brokers columns; the
+    # uncapped path (db is None) keeps the original direct slices — no
+    # identity gathers inside the per-round while loop
+    gb = (lambda x: x) if db is None else (lambda x: x[db])
+    ncols = B if db is None else db.shape[0]
     r = jnp.where(cand_valid, cand, 0)
     p = state.replica_partition[r]
     topic = state.partition_topic[p]
@@ -409,12 +421,12 @@ def move_dst_matrix(
     eff = snap.eff_load[r]                      # f32[S, 4]
     leads = snap.is_leader[r]
 
-    ok = jnp.ones((S, B), bool)
+    ok = jnp.ones((S, ncols), bool)
 
     # RackAwareGoal (and the kafka-assigner strict variant)
-    dst_rack = state.broker_rack[None, :]       # [1, B]
+    dst_rack = gb(state.broker_rack)[None, :]    # [1, cols]
     src_rack = state.broker_rack[src][:, None]  # [S, 1]
-    occ = snap.rack_counts[p][:, state.broker_rack] - (src_rack == dst_rack).astype(jnp.int32)
+    occ = snap.rack_counts[p][:, gb(state.broker_rack)] - (src_rack == dst_rack).astype(jnp.int32)
     strict_rack = prior_mask[G.RACK_AWARE] | prior_mask[G.KAFKA_ASSIGNER_RACK]
     ok &= jnp.where(strict_rack, occ == 0, True)
 
@@ -428,7 +440,7 @@ def move_dst_matrix(
 
     # BrokerSetAwareGoal: destination stays inside the topic's broker set
     want = ctx.broker_set_of_topic[topic][:, None]
-    have = ctx.broker_set_of_broker[None, :]
+    have = gb(ctx.broker_set_of_broker)[None, :]
     ok &= jnp.where(
         prior_mask[G.BROKER_SET_AWARE], (want < 0) | (have == want), True
     )
@@ -444,18 +456,18 @@ def move_dst_matrix(
     counts = snap.replica_counts
     ok &= jnp.where(
         prior_mask[G.REPLICA_CAPACITY],
-        (counts[None, :] + 1 <= ctx.constraint.max_replicas_per_broker),
+        (gb(counts)[None, :] + 1 <= ctx.constraint.max_replicas_per_broker),
         True,
     )
 
     # Capacity goals
     for gid, res in G.CAPACITY_RESOURCE.items():
-        fits = snap.broker_load[None, :, res] + eff[:, None, res] <= snap.cap_limits[None, :, res]
+        fits = gb(snap.broker_load[:, res])[None, :] + eff[:, None, res] <= gb(snap.cap_limits[:, res])[None, :]
         ok &= jnp.where(prior_mask[gid], fits, True)
 
     # ReplicaDistributionGoal
     upper = snap.replica_band[1]
-    dst_after = counts[None, :] + 1
+    dst_after = gb(counts)[None, :] + 1
     rd_ok = (dst_after <= upper) | (dst_after <= counts[src][:, None] - 1)
     ok &= jnp.where(prior_mask[G.REPLICA_DISTRIBUTION], rd_ok, True)
 
@@ -464,8 +476,8 @@ def move_dst_matrix(
         state.base_load[r, Resource.NW_OUT]
         + state.leadership_delta[p, Resource.NW_OUT]
     )
-    pnw_after = snap.potential_nw_out[None, :] + leader_nw[:, None]
-    pnw_ok = pnw_after <= snap.cap_limits[None, :, Resource.NW_OUT]
+    pnw_after = gb(snap.potential_nw_out)[None, :] + leader_nw[:, None]
+    pnw_ok = pnw_after <= gb(snap.cap_limits[:, Resource.NW_OUT])[None, :]
     ok &= jnp.where(prior_mask[G.POTENTIAL_NW_OUT], pnw_ok, True)
 
     # ResourceDistributionGoals
@@ -473,16 +485,16 @@ def move_dst_matrix(
         low = snap.low_util[res]
         cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
         src_before = snap.broker_load[src, res]
-        dst_before = snap.broker_load[:, res][None, :]
+        dst_before = gb(snap.broker_load[:, res])[None, :]
         src_after = src_before - eff[:, res]
         dst_after_l = dst_before + eff[:, None, res]
         within_before = (src_before >= snap.res_lower[src, res])[:, None] & (
-            dst_before <= snap.res_upper[None, :, res]
+            dst_before <= gb(snap.res_upper[:, res])[None, :]
         )
-        ok_within = (dst_after_l <= snap.res_upper[None, :, res]) & (
+        ok_within = (dst_after_l <= gb(snap.res_upper[:, res])[None, :]) & (
             src_after >= snap.res_lower[src, res]
         )[:, None]
-        ok_fb = dst_after_l / cap[None, :] <= (src_before / cap[src])[:, None]
+        ok_fb = dst_after_l / gb(cap)[None, :] <= (src_before / cap[src])[:, None]
         no_load = (eff[:, res] <= 0.0)[:, None]
         dist_ok = low | no_load | jnp.where(within_before, ok_within, ok_fb)
         ok &= jnp.where(prior_mask[gid], dist_ok, True)
@@ -491,7 +503,7 @@ def move_dst_matrix(
     if snap.enable_heavy:
         bt = snap.topic_counts
         tup = snap.topic_band[1]
-        dst_t_after = bt[:, topic].T + 1                      # [S, B]
+        dst_t_after = gb(bt)[:, topic].T + 1                  # [S, cols]
         td_ok = (dst_t_after <= tup[topic][:, None]) | (
             dst_t_after <= bt[src, topic][:, None] - 1
         )
@@ -499,7 +511,7 @@ def move_dst_matrix(
 
     # LeaderReplicaDistributionGoal (only when the moved replica leads)
     lupper = snap.leader_band[1]
-    l_after = snap.leader_counts[None, :] + 1
+    l_after = gb(snap.leader_counts)[None, :] + 1
     ld_ok = (~leads)[:, None] | (l_after <= lupper) | (
         l_after <= snap.leader_counts[src][:, None] - 1
     )
@@ -507,7 +519,7 @@ def move_dst_matrix(
 
     # LeaderBytesInDistributionGoal (only when the moved replica leads)
     nw_in = eff[:, Resource.NW_IN]
-    lbi_after = snap.leader_nw_in[None, :] + jnp.where(leads, nw_in, 0.0)[:, None]
+    lbi_after = gb(snap.leader_nw_in)[None, :] + jnp.where(leads, nw_in, 0.0)[:, None]
     lbi_ok = (~leads)[:, None] | (lbi_after <= snap.leader_nw_in_upper) | (
         lbi_after <= snap.leader_nw_in[src][:, None]
     )

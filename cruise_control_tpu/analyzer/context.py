@@ -26,6 +26,7 @@ from cruise_control_tpu.analyzer.constraint import BalancingConstraint
 from cruise_control_tpu.core.resources import NUM_RESOURCES, Resource
 from cruise_control_tpu.model import arrays as A
 from cruise_control_tpu.model.arrays import ClusterArrays
+from cruise_control_tpu.ops.segments import segment_sum as _segment_sum
 
 NEG = jnp.float32(-3e38)
 
@@ -206,7 +207,7 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         ctx.triggered_by_violation,
     )
 
-    lbi = jax.ops.segment_sum(
+    lbi = _segment_sum(
         jnp.where(lead, eff[:, Resource.NW_IN], 0.0),
         state.replica_broker,
         num_segments=state.num_brokers,
@@ -220,7 +221,7 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
     d_usable = state.disk_alive & (state.disk_capacity > 0.0)
     d_limit = c.resource_capacity_threshold[Resource.DISK] * state.disk_capacity
     on_disk = state.replica_disk >= 0
-    d_counts = jax.ops.segment_sum(
+    d_counts = _segment_sum(
         (on_disk & state.replica_valid).astype(jnp.int32),
         jnp.where(on_disk, state.replica_disk, state.num_disks),
         num_segments=max(state.num_disks, 1),
@@ -228,11 +229,11 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
     if state.num_disks > 0:
         # band around each broker's mean usable-disk utilization
         # (IntraBrokerDiskUsageDistributionGoal balances a broker's own disks)
-        per_b_load = jax.ops.segment_sum(
+        per_b_load = _segment_sum(
             jnp.where(d_usable, dload, 0.0), state.disk_broker,
             num_segments=state.num_brokers,
         )
-        per_b_cap = jax.ops.segment_sum(
+        per_b_cap = _segment_sum(
             jnp.where(d_usable, state.disk_capacity, 0.0), state.disk_broker,
             num_segments=state.num_brokers,
         )
@@ -269,7 +270,7 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         t_lo = jnp.maximum(0, jnp.ceil(avg_t).astype(jnp.int32) - gap)
         topic_band = jnp.stack([t_lo, t_up])
         flat = state.replica_broker * state.num_topics + topic
-        topic_leader_counts = jax.ops.segment_sum(
+        topic_leader_counts = _segment_sum(
             lead.astype(jnp.int32), flat,
             num_segments=state.num_brokers * state.num_topics,
         ).reshape(state.num_brokers, state.num_topics)

@@ -33,6 +33,7 @@ from cruise_control_tpu.analyzer.proposers import (
 )
 from cruise_control_tpu.core.resources import Resource
 from cruise_control_tpu.model.arrays import ClusterArrays
+from cruise_control_tpu.ops.segments import segment_sum as _segment_sum
 
 RoundFn = Callable[[ClusterArrays, GoalContext, Snapshot, jax.Array, jax.Array], MoveBatch]
 
@@ -59,7 +60,7 @@ def offline_round(
     that every goal first relocates offline replicas (self-healing semantics of
     AbstractGoal's dead-broker handling).  Destinations must be rack-safe and under
     all capacity limits so the subsequent goal phases start from a feasible point."""
-    offline_per_broker = jax.ops.segment_sum(
+    offline_per_broker = _segment_sum(
         snap.offline.astype(jnp.float32), state.replica_broker,
         num_segments=state.num_brokers,
     )
@@ -92,7 +93,7 @@ def offline_round_relaxed(
     """Fallback offline repair without rack/capacity preconditions — ensures no
     replica is stranded on a dead broker even in tight clusters (the goals then
     re-balance); only destination aliveness and partition-uniqueness are required."""
-    offline_per_broker = jax.ops.segment_sum(
+    offline_per_broker = _segment_sum(
         snap.offline.astype(jnp.float32), state.replica_broker,
         num_segments=state.num_brokers,
     )
@@ -119,7 +120,7 @@ def rack_round(
     prior_mask: jax.Array, salt: jax.Array,
 ) -> MoveBatch:
     viol = G.rack_violating_replicas(state, snap)
-    src_need = jax.ops.segment_sum(
+    src_need = _segment_sum(
         viol.astype(jnp.float32), state.replica_broker, num_segments=state.num_brokers
     )
 
@@ -636,7 +637,7 @@ def preferred_leader_round(
     )
     idx = jnp.arange(state.num_replicas, dtype=jnp.int32)
     wrong = snap.is_leader & pref_usable & (pref_of_r != idx) & snap.leader_movable
-    src_need = jax.ops.segment_sum(
+    src_need = _segment_sum(
         wrong.astype(jnp.float32), state.replica_broker, num_segments=B
     )
     cands = topk_segment_argmax(
@@ -671,7 +672,7 @@ def rack_dist_round(
     rack_of_r = state.broker_rack[state.replica_broker]
     occ_r = snap.rack_counts[p_of_r, rack_of_r]
     viol = state.replica_valid & (occ_r > fair[p_of_r])
-    src_need = jax.ops.segment_sum(
+    src_need = _segment_sum(
         viol.astype(jnp.float32), state.replica_broker, num_segments=state.num_brokers
     )
 
@@ -731,7 +732,7 @@ def broker_set_round(
     want = ctx.broker_set_of_topic[topic]
     have = ctx.broker_set_of_broker[state.replica_broker]
     viol = state.replica_valid & (want >= 0) & (have != want)
-    src_need = jax.ops.segment_sum(
+    src_need = _segment_sum(
         viol.astype(jnp.float32), state.replica_broker, num_segments=state.num_brokers
     )
 

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from cruise_control_tpu.analyzer.context import GoalContext, Snapshot
 from cruise_control_tpu.core.resources import Resource
 from cruise_control_tpu.model.arrays import ClusterArrays
+from cruise_control_tpu.ops.segments import segment_sum as _segment_sum
 
 # -- goal ids (priority-list members) ---------------------------------------------
 
@@ -135,7 +136,7 @@ def rack_violating_replicas(state: ClusterArrays, snap: Snapshot) -> jax.Array:
     group = state.replica_partition * state.num_racks + rack
     n_groups = state.num_partitions * state.num_racks
     ones = state.replica_valid.astype(jnp.int32)
-    group_size = jax.ops.segment_sum(ones, group, num_segments=n_groups)
+    group_size = _segment_sum(ones, group, num_segments=n_groups)
     idx = jnp.arange(state.num_replicas, dtype=jnp.int32)
     big = jnp.int32(2**30)
     first = jax.ops.segment_min(
@@ -231,7 +232,7 @@ def violations_all(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> ja
     # alive-rack count allows (relaxed rack awareness — ceil(RF / racks) per rack)
     from cruise_control_tpu.analyzer.context import rack_fair_share
 
-    rf_p = jax.ops.segment_sum(
+    rf_p = _segment_sum(
         state.replica_valid.astype(jnp.int32),
         state.replica_partition,
         num_segments=state.num_partitions,

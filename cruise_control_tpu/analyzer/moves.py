@@ -29,6 +29,8 @@ conservative one-action-per-broker rule instead of cumulative admission.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -54,6 +56,11 @@ class MoveBatch:
     score: jax.Array        # f32[K] admission priority (higher admits first)
     #: i32[K] destination logdir for KIND_INTRA_MOVE batches; None otherwise
     dst_disk: "jax.Array | None" = None
+    #: i32 scalar — number of rotating source windows this round's cap spans
+    #: (see proposers._cap_sources).  The phase loop must see this many
+    #: consecutive zero-move rounds before declaring convergence; uncapped
+    #: rounds leave it at 1 (one zero round proves the fixpoint).
+    windows: jax.Array = dataclasses.field(default_factory=lambda: jnp.int32(1))
 
     @property
     def num_slots(self) -> int:

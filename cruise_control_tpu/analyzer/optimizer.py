@@ -238,8 +238,16 @@ def _phase(state, ctx, prior_mask, admit_mask, *, round_fn, max_rounds, enable_h
     proposers as a tie-breaking salt.
     """
 
+    # With capped sources (_cap_sources) a round only offers a rotating window
+    # over the need-ranked active sources; a zero-move round therefore only
+    # proves *that window* stuck.  Convergence requires a full rotation of
+    # zero-move rounds — the rotation length is DYNAMIC (``MoveBatch.windows``,
+    # constant while no moves apply since need is a pure function of state), so
+    # a converged phase (no active sources → windows == 1) exits after one
+    # zero round while a 10k-broker phase mid-flight tolerates ⌈active/M⌉.
+
     def body(carry):
-        state, it, total, _ = carry
+        state, it, total, streak, _ = carry
         snap = take_snapshot(state, ctx, enable_heavy)
         moves = round_fn(state, ctx, snap, prior_mask, it)
         eff = move_effects(state, moves)
@@ -247,14 +255,15 @@ def _phase(state, ctx, prior_mask, admit_mask, *, round_fn, max_rounds, enable_h
         keep = admit(state, ctx, snap, moves, ok, eff, admit_mask)
         n = keep.sum().astype(jnp.int32)
         state = apply_moves(state, moves, keep)
-        return state, it + 1, total + n, n
+        streak = jnp.where(n > 0, 0, streak + 1)
+        return state, it + 1, total + n, streak, moves.windows
 
     def cond(carry):
-        _, it, _, last = carry
-        return (last > 0) & (it < max_rounds)
+        _, it, _, streak, windows = carry
+        return (streak < windows) & (it < max_rounds)
 
-    state, iters, total, _ = jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1))
+    state, iters, total, _, _ = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(1))
     )
     return state, iters, total
 

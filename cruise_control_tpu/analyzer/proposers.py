@@ -62,16 +62,6 @@ def _pair_jitter(a: jax.Array, b: jax.Array, salt: jax.Array = 0) -> jax.Array:
     return -TIEBREAK * h.astype(jnp.float32) / 1024.0
 
 
-def _cyclic_tiebreak(row_ids: jax.Array, num_cols: int, salt: jax.Array) -> jax.Array:
-    """f32[rows, cols] in (-TIEBREAK, 0]: per-(row, col, round) jitter so
-    equal-scored destinations spread across sources — without this, every source
-    picks the same "best" destination and per-destination admission throttles the
-    round.  A plain cyclic offset is not enough (contiguous source blocks all
-    prefer the same first eligible column), hence the hash."""
-    cols = jnp.arange(num_cols, dtype=jnp.int32)[None, :]
-    return _pair_jitter(row_ids[:, None], cols, salt)
-
-
 def topk_segment_argmax(
     scores: jax.Array, seg: jax.Array, num_segments: int, eligible: jax.Array, k: int
 ) -> jax.Array:
@@ -91,14 +81,22 @@ def topk_segment_argmax(
 
 
 def _partition_occupancy(
-    state: ClusterArrays, cand: jax.Array, cand_valid: jax.Array
+    state: ClusterArrays,
+    cand: jax.Array,
+    cand_valid: jax.Array,
+    dst_brokers: "jax.Array | None" = None,
 ) -> jax.Array:
-    """bool[S, B]: does candidate s's partition already have a replica on broker b?
+    """bool[S, B|M]: does candidate s's partition already have a replica on the
+    column's broker?
 
     Brokers may host at most one replica of a partition (a Kafka invariant, not a
     goal) — enforced here for every replica-move round so it holds under *any*
     goal list, not just when RackAwareGoal's acceptance kernel is active.
-    Cost: one scatter over R plus an [S, B] gather; no [P, B] materialization.
+    Cost: one scatter over R plus an [S, cols] gather; no [P, B] materialization.
+
+    ``dst_brokers`` (unique broker ids, i32[M]) restricts the columns to those
+    brokers — the capped-round path that keeps the matrix at [S, M] instead of
+    [S, B] (crucial when B is 10k).
 
     Returns ``occupied | ~unique``: slots whose partition lost the inverse-map
     race (two candidates sharing a partition) are fully masked — they simply sit
@@ -113,26 +111,60 @@ def _partition_occupancy(
     slot = slot.at[p_cand].set(jnp.arange(S, dtype=jnp.int32), mode="drop")
     p_safe = jnp.where(cand_valid, p_cand, 0)
     unique = cand_valid & (slot[p_safe] == jnp.arange(S, dtype=jnp.int32))
-    # scatter every live replica into (slot, broker) occupancy
+    # scatter every live replica into (slot, broker-column) occupancy
     r_slot = slot[state.replica_partition]
-    occupied = jnp.zeros((S, state.num_brokers), bool)
+    if dst_brokers is None:
+        ncols = state.num_brokers
+        col_of_broker = None
+    else:
+        ncols = dst_brokers.shape[0]
+        # inverse map broker id → column position; brokers outside the window
+        # scatter to the dropped ncols column (requires unique dst_brokers)
+        col_of_broker = jnp.full(state.num_brokers, ncols, jnp.int32)
+        col_of_broker = col_of_broker.at[dst_brokers].set(
+            jnp.arange(ncols, dtype=jnp.int32)
+        )
+    occupied = jnp.zeros((S, ncols), bool)
     oob = jnp.int32(S)
     rows = jnp.where((r_slot >= 0) & state.replica_valid, r_slot, oob)
-    occupied = occupied.at[rows, state.replica_broker].set(True, mode="drop")
+    cols = (
+        state.replica_broker
+        if col_of_broker is None
+        else col_of_broker[state.replica_broker]
+    )
+    occupied = occupied.at[rows, cols].set(True, mode="drop")
     return occupied | ~unique[:, None]
 
 
-def _cap_sources(need: jax.Array, max_active: int) -> "jax.Array | None":
-    """i32[M] ids of the M neediest brokers, or None when no cap is required.
+def _cap_sources(
+    need: jax.Array, max_active: int, salt: jax.Array = 0
+) -> "Tuple[jax.Array | None, jax.Array]":
+    """(ids, windows): i32[M] ids of M needy sources (None = no cap required)
+    plus the current rotation length (i32 scalar ≥ 1).
 
     Bounds every [slots, B] matrix to top_k·M·B (vs top_k·B² uncapped — tens of
-    GB at 10k brokers).  Brokers beyond the cap retry in later rounds of the
-    same while-loop; the fixpoint is unchanged, only reached in more rounds."""
+    GB at 10k brokers).  The window *rotates* with the round number over the
+    need-sorted active sources: round r serves ranks [r·M, r·M + M) cyclically,
+    so a stuck top-M set (every destination vetoed) cannot starve a feasible
+    source beyond the cap — every active source is offered a round within
+    ``windows`` rounds.  Proposers surface ``windows`` on the MoveBatch so the
+    phase loop (optimizer._phase) tolerates exactly one full rotation of
+    zero-move rounds before declaring convergence — dynamic, so a converged
+    phase (no active sources → windows == 1) still exits after one round.
+
+    The returned ids are always distinct (both branches index `order`, a
+    permutation, at M distinct positions) — `_partition_occupancy`'s
+    ``dst_brokers`` precondition."""
     B = need.shape[0]
+    one = jnp.int32(1)
     if B <= max_active:
-        return None
-    _, idx = jax.lax.top_k(need, max_active)
-    return idx.astype(jnp.int32)
+        return None, one
+    order = jnp.argsort(-need).astype(jnp.int32)      # need-descending broker ids
+    n_active = jnp.maximum((need > 0).sum(), 1)
+    windows = jnp.maximum((n_active + max_active - 1) // max_active, 1).astype(jnp.int32)
+    start = (jnp.asarray(salt, jnp.int32) % windows) * max_active
+    pos = (start + jnp.arange(max_active, dtype=jnp.int32)) % jnp.maximum(n_active, max_active)
+    return order[pos % B], windows
 
 
 def shed_round(
@@ -156,7 +188,7 @@ def shed_round(
     k = ctx.top_k
     active = src_need > 0
     cands = topk_segment_argmax(cand_score, state.replica_broker, B, cand_ok, k)
-    chosen = _cap_sources(src_need, ctx.max_active_brokers)
+    chosen, windows = _cap_sources(src_need, ctx.max_active_brokers, salt)
     if chosen is None:
         cand = cands.reshape(-1)                               # slot = j·B + b
         src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
@@ -188,6 +220,7 @@ def shed_round(
         dst_broker=jnp.where(replica >= 0, dst, -1),
         dst_replica=jnp.full(S, -1, jnp.int32),
         score=jnp.where(replica >= 0, src_need[src_of_slot], 0.0),
+        windows=windows,
     )
 
 
@@ -221,21 +254,25 @@ def fill_round(
     cand0 = cands_k[0]
     cand0_safe = jnp.where(cand0 >= 0, cand0, 0)
 
-    rows = _cap_sources(dst_need, ctx.max_active_brokers)
-    row_brokers = rows if rows is not None else jnp.arange(B, dtype=jnp.int32)
+    cap_rows, windows = _cap_sources(dst_need, ctx.max_active_brokers, salt)
+    row_brokers = cap_rows if cap_rows is not None else jnp.arange(B, dtype=jnp.int32)
     M = row_brokers.shape[0]
 
-    fits, sscore = fit_fn(cand0_safe, rows)   # rows = destination, cols = donor
+    fits, sscore = fit_fn(cand0_safe, cap_rows)   # rows = destination, cols = donor
     cols = jnp.arange(B, dtype=jnp.int32)
     has_cand = (cand0 >= 0)[None, :]
     not_self = cols[None, :] != row_brokers[:, None]
     dst_is_ok = (snap.dest_ok & active)[row_brokers][:, None]
     fits = fits & has_cand & not_self & dst_is_ok
-    # [donor_slot, dst] acceptance, gathered at the active rows → [M, donor]
-    fits = fits & move_dst_matrix(state, ctx, snap, cand0_safe, cand0 >= 0, prior_mask)[
-        :, row_brokers
-    ].T
-    fits = fits & ~_partition_occupancy(state, cand0_safe, cand0 >= 0)[:, row_brokers].T
+    # [donor_slot, dst] acceptance restricted to the active destination rows —
+    # [donor, M] instead of [donor, B], keeping the fill path within the
+    # top_k·M·B bound the cap promises
+    fits = fits & move_dst_matrix(
+        state, ctx, snap, cand0_safe, cand0 >= 0, prior_mask, dst_brokers=cap_rows
+    ).T
+    fits = fits & ~_partition_occupancy(
+        state, cand0_safe, cand0 >= 0, dst_brokers=cap_rows
+    ).T
     sscore = sscore + _pair_jitter(row_brokers[:, None], cols[None, :], salt)
     sscore = jnp.where(fits, sscore, NEG)
 
@@ -269,9 +306,17 @@ def fill_round(
     slot_valid = replica >= 0
     r_safe = jnp.where(slot_valid, replica, 0)
     d_safe = jnp.where(slot_valid, dstv, 0)
-    rows = jnp.arange(K, dtype=jnp.int32)
-    pair_ok = move_dst_matrix(state, ctx, snap, r_safe, slot_valid, prior_mask)[rows, d_safe]
-    pair_ok &= ~_partition_occupancy(state, r_safe, slot_valid)[rows, d_safe]
+    slot_idx = jnp.arange(K, dtype=jnp.int32)
+    # slot j·M + m targets row_brokers[m]: the restricted [K, M] matrices are
+    # indexed at column m = slot % M; the uncapped path keeps full [K, B]
+    # matrices indexed at the destination broker id itself
+    col = slot_idx % M if cap_rows is not None else d_safe
+    pair_ok = move_dst_matrix(
+        state, ctx, snap, r_safe, slot_valid, prior_mask, dst_brokers=cap_rows
+    )[slot_idx, col]
+    pair_ok &= ~_partition_occupancy(state, r_safe, slot_valid, dst_brokers=cap_rows)[
+        slot_idx, col
+    ]
     pair_ok &= d_safe != state.replica_broker[r_safe]
     replica = jnp.where(slot_valid & pair_ok, replica, -1)
     return MoveBatch(
@@ -280,6 +325,7 @@ def fill_round(
         dst_broker=jnp.where(replica >= 0, dstv, -1),
         dst_replica=jnp.full(K, -1, jnp.int32),
         score=jnp.where(replica >= 0, need, 0.0),
+        windows=windows,
     )
 
 
@@ -413,7 +459,7 @@ def swap_round(
 
     # top-k outgoing replicas per active source (neediest sources when capped)
     cands = topk_segment_argmax(out_score, state.replica_broker, B, out_ok, k)
-    chosen = _cap_sources(src_need, ctx.max_active_brokers)
+    chosen, windows = _cap_sources(src_need, ctx.max_active_brokers, salt)
     if chosen is None:
         cand = cands.reshape(-1)
         src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
@@ -432,8 +478,17 @@ def swap_round(
     ok = ok & (p_out[:, None] != p_in[None, :])
     # occupancy both directions (a broker may hold one replica per partition)
     ok = ok & ~_partition_occupancy(state, cand_safe, valid)
-    occ_in = _partition_occupancy(state, partner_safe, partner_valid)  # [B, B]
-    ok = ok & ~occ_in[:, src_of_slot].T
+    if chosen is None:
+        occ_in = _partition_occupancy(state, partner_safe, partner_valid)  # [B, B]
+        ok = ok & ~occ_in[:, src_of_slot].T
+    else:
+        # src_of_slot = tile(chosen, k): slot s targets chosen[s % M], so the
+        # restricted [B, M] occupancy is gathered at column s % M
+        occ_in = _partition_occupancy(
+            state, partner_safe, partner_valid, dst_brokers=chosen
+        )
+        S_ = src_of_slot.shape[0]
+        ok = ok & ~occ_in[:, jnp.arange(S_, dtype=jnp.int32) % chosen.shape[0]].T
     # prior-goal acceptance with the swap's NET deltas — two bare-move checks
     # would veto exactly the pinned cases swaps exist for (e.g. replica counts
     # at the max: a move is rejected, a count-neutral swap is fine)
@@ -454,6 +509,7 @@ def swap_round(
         dst_broker=jnp.where(replica >= 0, dst, -1),
         dst_replica=jnp.where(replica >= 0, partner[dst_safe], -1),
         score=jnp.where(replica >= 0, src_need[src_of_slot], 0.0),
+        windows=windows,
     )
 
 
@@ -482,7 +538,7 @@ def intra_disk_round(
     seg = jnp.where(on_disk, state.replica_disk, D)
     active = src_need > 0
     cands = topk_segment_argmax(cand_score, seg, D, cand_ok & on_disk, k)
-    chosen = _cap_sources(src_need, ctx.max_active_brokers)
+    chosen, windows = _cap_sources(src_need, ctx.max_active_brokers, salt)
     if chosen is None:
         cand = cands.reshape(-1)
         src_disk_of_slot = jnp.tile(jnp.arange(D, dtype=jnp.int32), k)
@@ -513,4 +569,5 @@ def intra_disk_round(
         dst_replica=jnp.full(S, -1, jnp.int32),
         score=jnp.where(replica >= 0, src_need[src_disk_of_slot], 0.0),
         dst_disk=jnp.where(replica >= 0, dst, -1),
+        windows=windows,
     )

@@ -120,6 +120,7 @@ class CruiseControlTpuApp:
         cfg = Config(cruise_control_config(), props)
         self.config = cfg
 
+        self._demo_backend = False
         if backend is None:
             spec = props.get("cluster.backend.class")
             if spec:
@@ -127,7 +128,18 @@ class CruiseControlTpuApp:
             else:
                 from cruise_control_tpu.backend import FakeClusterBackend
 
+                # no real cluster configured: boot against a seeded in-process
+                # demo cluster (the embedded-harness equivalent) so the REST
+                # surface serves real responses out of the box
                 backend = FakeClusterBackend()
+                if cfg.get("demo.cluster.brokers") > 0:
+                    backend.seed_demo(
+                        num_brokers=cfg.get("demo.cluster.brokers"),
+                        num_racks=cfg.get("demo.cluster.racks"),
+                        num_partitions=cfg.get("demo.cluster.partitions"),
+                        replication_factor=cfg.get("demo.cluster.replication.factor"),
+                    )
+                    self._demo_backend = True
         self.backend = backend
 
         sampler_cls = resolve_class(cfg.get("metric.sampler.class"))
@@ -247,6 +259,15 @@ class CruiseControlTpuApp:
         self.cruise_control.start()
         self.anomaly_manager.start_detection()
         interval_s = self.config.get("metric.sampling.interval.ms") / 1000.0
+
+        if self._demo_backend and self.config.get("demo.bootstrap.on.start"):
+            # backfill one full window ring of demo metrics (BOOTSTRAP
+            # semantics, LoadMonitorTaskRunner.bootstrap:137-174) so
+            # LOAD/PROPOSALS have stable windows immediately instead of after
+            # num_windows · window_ms of wall clock
+            now_ms = int(time.time() * 1000)
+            span = (self.monitor.num_windows + 1) * self.monitor.window_ms
+            self.monitor.bootstrap(now_ms - span, now_ms)
 
         def _sampling_loop():
             while not self._stop.wait(interval_s):

@@ -91,6 +91,41 @@ class FakeClusterBackend(ClusterBackend):
                 path: LogdirInfo(path, cap, offline=False) for path, cap in dirs.items()
             }
 
+    def seed_demo(
+        self,
+        num_brokers: int = 8,
+        num_racks: int = 2,
+        num_partitions: int = 64,
+        replication_factor: int = 2,
+        num_topics: int = 4,
+    ) -> "FakeClusterBackend":
+        """Populate a deterministic demo topology (skewed loads so the analyzer
+        has real work).  The out-of-box equivalent of pointing the reference at
+        a live cluster: ``python -m cruise_control_tpu`` boots against this
+        unless ``cluster.backend.class`` names a real backend.
+        """
+        for b in range(num_brokers):
+            self.add_broker(b, rack=str(b % num_racks))
+        rf = min(replication_factor, max(num_brokers, 1))
+        for p in range(num_partitions):
+            topic = f"demo-{p % max(num_topics, 1)}"
+            # skew leaders onto the first half of the brokers
+            first = p % max(num_brokers // 2, 1)
+            replicas = [(first + i * num_racks + (i > 0)) % num_brokers for i in range(rf)]
+            # dedupe while preserving order (tiny clusters can collide)
+            seen: List[int] = []
+            for r in replicas:
+                while r in seen:
+                    r = (r + 1) % num_brokers
+                seen.append(r)
+            scale = 1.0 + (p * 7919 % 13) / 4.0
+            self.create_partition(
+                (topic, p // max(num_topics, 1)),
+                seen,
+                load=[0.8 * scale, 2e3 * scale, 3e3 * scale, 2e4 * scale],
+            )
+        return self
+
     def create_partition(
         self,
         tp: TopicPartition,

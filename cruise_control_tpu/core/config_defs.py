@@ -38,6 +38,19 @@ def monitor_config() -> ConfigDef:
     d.define("broker.capacity.config.resolver.class", Type.CLASS,
              "cruise_control_tpu.monitor.capacity.FileCapacityResolver", M,
              "BrokerCapacityResolver implementation.")
+    d.define("demo.cluster.brokers", Type.INT, 8, L,
+             "Brokers the default in-process demo backend seeds when no "
+             "cluster.backend.class is configured (0 = boot empty).",
+             in_range(lo=0))
+    d.define("demo.cluster.racks", Type.INT, 2, L,
+             "Racks of the demo backend topology.", in_range(lo=1))
+    d.define("demo.cluster.partitions", Type.INT, 64, L,
+             "Partitions of the demo backend topology.", in_range(lo=0))
+    d.define("demo.cluster.replication.factor", Type.INT, 2, L,
+             "Replication factor of the demo backend topology.", in_range(lo=1))
+    d.define("demo.bootstrap.on.start", Type.BOOLEAN, True, L,
+             "Backfill a full window ring of demo metrics at startup "
+             "(BOOTSTRAP semantics) so LOAD/PROPOSALS serve immediately.")
     d.define("capacity.config.file", Type.STRING, "config/capacity.json", M,
              "Capacity file for the file resolver (capacity.json / capacityJBOD.json).")
     d.define("metric.sampler.class", Type.CLASS,

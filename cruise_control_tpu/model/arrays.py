@@ -44,6 +44,7 @@ from cruise_control_tpu.core.resources import (
     DerivedResource,
     Resource,
 )
+from cruise_control_tpu.ops.segments import segment_sum as _segment_sum
 
 
 @struct.dataclass
@@ -123,7 +124,7 @@ def effective_load(state: ClusterArrays) -> jax.Array:
 
 def broker_load(state: ClusterArrays) -> jax.Array:
     """f32[B, 4]: total utilization per broker (ClusterModel per-broker Load)."""
-    return jax.ops.segment_sum(
+    return _segment_sum(
         effective_load(state), state.replica_broker, num_segments=state.num_brokers
     )
 
@@ -131,12 +132,12 @@ def broker_load(state: ClusterArrays) -> jax.Array:
 def host_load(state: ClusterArrays) -> jax.Array:
     """f32[H, 4]: total utilization per host (host-level resources CPU/NW)."""
     per_broker = broker_load(state)
-    return jax.ops.segment_sum(per_broker, state.broker_host, num_segments=state.num_hosts)
+    return _segment_sum(per_broker, state.broker_host, num_segments=state.num_hosts)
 
 
 def broker_replica_counts(state: ClusterArrays) -> jax.Array:
     """i32[B]: replicas hosted per broker."""
-    return jax.ops.segment_sum(
+    return _segment_sum(
         state.replica_valid.astype(jnp.int32),
         state.replica_broker,
         num_segments=state.num_brokers,
@@ -145,7 +146,7 @@ def broker_replica_counts(state: ClusterArrays) -> jax.Array:
 
 def broker_leader_counts(state: ClusterArrays) -> jax.Array:
     """i32[B]: leader replicas per broker."""
-    return jax.ops.segment_sum(
+    return _segment_sum(
         is_leader(state).astype(jnp.int32),
         state.replica_broker,
         num_segments=state.num_brokers,
@@ -163,7 +164,7 @@ def potential_nw_out(state: ClusterArrays) -> jax.Array:
         + state.leadership_delta[state.replica_partition, Resource.NW_OUT]
     )
     leader_nw_out = jnp.where(state.replica_valid, leader_nw_out, 0.0)
-    return jax.ops.segment_sum(
+    return _segment_sum(
         leader_nw_out, state.replica_broker, num_segments=state.num_brokers
     )
 
@@ -175,7 +176,7 @@ def disk_load(state: ClusterArrays) -> jax.Array:
     du = jnp.where(state.replica_valid, state.base_load[:, Resource.DISK], 0.0)
     disk_idx = jnp.where(state.replica_disk >= 0, state.replica_disk, 0)
     du = jnp.where(state.replica_disk >= 0, du, 0.0)
-    return jax.ops.segment_sum(du, disk_idx, num_segments=state.num_disks)
+    return _segment_sum(du, disk_idx, num_segments=state.num_disks)
 
 
 def utilization_matrix(state: ClusterArrays) -> jax.Array:
@@ -189,7 +190,7 @@ def utilization_matrix(state: ClusterArrays) -> jax.Array:
     eff = effective_load(state)
     lead = is_leader(state)
     B = state.num_brokers
-    seg = lambda x: jax.ops.segment_sum(x, state.replica_broker, num_segments=B)
+    seg = lambda x: _segment_sum(x, state.replica_broker, num_segments=B)
 
     nw_in = eff[:, Resource.NW_IN]
     rows = jnp.zeros((NUM_DERIVED_RESOURCES, B), jnp.float32)
@@ -212,7 +213,7 @@ def topic_replica_counts_by_broker(state: ClusterArrays) -> jax.Array:
     """i32[B, T]: replicas of each topic on each broker (TopicReplicaDistributionGoal)."""
     topic = state.partition_topic[state.replica_partition]
     flat = state.replica_broker * state.num_topics + topic
-    counts = jax.ops.segment_sum(
+    counts = _segment_sum(
         state.replica_valid.astype(jnp.int32),
         flat,
         num_segments=state.num_brokers * state.num_topics,
@@ -224,7 +225,7 @@ def replicas_per_rack_per_partition(state: ClusterArrays) -> jax.Array:
     """i32[P, num_racks]: replica count of each partition in each rack (RackAwareGoal)."""
     rack = state.broker_rack[state.replica_broker]
     flat = state.replica_partition * state.num_racks + rack
-    counts = jax.ops.segment_sum(
+    counts = _segment_sum(
         state.replica_valid.astype(jnp.int32),
         flat,
         num_segments=state.num_partitions * state.num_racks,

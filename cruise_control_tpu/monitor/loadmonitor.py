@@ -91,6 +91,7 @@ class LoadMonitor:
         self.sampler = sampler
         self.capacity_resolver = capacity_resolver
         self.window_ms = window_ms
+        self.num_windows = num_windows
         self.sample_store = sample_store or NoopSampleStore()
         #: CPU apportioning weights; replaced by TRAIN when a fitted linear
         #: model is accepted (ModelParameters.updateModelCoefficient semantics)

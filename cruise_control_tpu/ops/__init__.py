@@ -1,0 +1,10 @@
+"""TPU kernels (Pallas) for the solver's hot array primitives.
+
+``ops.segments`` — per-broker/per-disk segment reductions as one-hot MXU
+contractions, with a backend-dispatching ``segment_sum`` drop-in.
+"""
+
+from cruise_control_tpu.ops.segments import (  # noqa: F401
+    segment_sum,
+    segment_sum_pallas,
+)
