@@ -14,14 +14,18 @@ project's own north-star budget of 30 s for a full rebalance
 """
 
 import json
-import subprocess
+import os
 import sys
 import time
 
-#: seconds to wait for the accelerator tunnel before falling back to CPU —
-#: when the tunnel is down, in-process backend init blocks ~25 minutes before
-#: erroring (observed), which would hang the whole benchmark run.
-BACKEND_PROBE_TIMEOUT_S = 180
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# shared dead-tunnel guard (also used by the app shell and bench_scale);
+# re-exported here because harnesses import `bench.ensure_live_backend`
+from cruise_control_tpu.core.backend_probe import (  # noqa: E402,F401
+    BACKEND_PROBE_TIMEOUT_S,
+    ensure_live_backend,
+)
 
 SCALE = dict(
     num_racks=10,
@@ -64,43 +68,6 @@ def run_once(state, ctx):
     return result
 
 
-def _probe_backend() -> str:
-    """The default backend's platform ('tpu' / 'cpu' / …), 'cpu' when dead.
-
-    Probes in a subprocess so a dead tunnel can be killed at the timeout
-    instead of blocking this process for its full internal retry budget; the
-    probe prints the actual platform so a CPU-only machine is never labeled
-    'tpu' in the benchmark JSON."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            timeout=BACKEND_PROBE_TIMEOUT_S,
-            capture_output=True,
-            text=True,
-        )
-        if proc.returncode == 0:
-            platform = proc.stdout.strip().splitlines()[-1].strip().lower()
-            # the tunneled accelerator registers as the experimental 'axon'
-            # platform but is a TPU chip
-            return "tpu" if platform == "axon" else platform
-    except subprocess.TimeoutExpired:
-        pass
-    return "cpu"
-
-
-def ensure_live_backend() -> str:
-    """Probe the default backend; force the CPU platform when it's dead.
-
-    Shared by bench.py / bench_scale.py / __graft_entry__.py so the dead-tunnel
-    fallback lives in one place.  Returns the platform that will be used."""
-    platform = _probe_backend()
-    if platform == "cpu":
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    return platform
-
-
 def main() -> None:
     platform = ensure_live_backend()
     state, ctx, maps = build()
@@ -121,6 +88,10 @@ def main() -> None:
                 "vs_baseline": round(NORTH_STAR_BUDGET_S / max(wall, 1e-9), 2),
                 "residual_hard_violations": residual_hard,
                 "total_moves": result.total_moves,
+                "inter_broker_moves": result.movement.num_inter_broker_moves,
+                "leadership_moves": result.movement.num_leadership_moves,
+                "data_to_move": round(result.movement.inter_broker_data_to_move, 3),
+                "num_dispatches": result.num_dispatches,
                 "balancedness": round(result.balancedness_score, 4),
                 "platform": platform,
             }

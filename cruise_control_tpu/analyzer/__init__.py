@@ -9,6 +9,7 @@ from cruise_control_tpu.analyzer.context import GoalContext
 from cruise_control_tpu.analyzer.optimizer import (
     GoalOptimizer,
     GoalReport,
+    MovementStats,
     OptimizationFailure,
     OptimizerResult,
 )
@@ -19,6 +20,7 @@ __all__ = [
     "GoalContext",
     "GoalOptimizer",
     "GoalReport",
+    "MovementStats",
     "OptimizationFailure",
     "OptimizerResult",
     "ExecutionProposal",

@@ -40,6 +40,7 @@ from cruise_control_tpu.analyzer.goal_rounds import (
 )
 from cruise_control_tpu.analyzer.moves import admit, apply_moves, move_effects
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal, diff as diff_proposals
+from cruise_control_tpu.core.resources import Resource
 from cruise_control_tpu.model import arrays as A
 from cruise_control_tpu.model import stats as S
 from cruise_control_tpu.model.arrays import ClusterArrays
@@ -191,6 +192,53 @@ def provision_verdict(
 
 
 @dataclasses.dataclass
+class MovementStats:
+    """Movement-volume accounting for a proposal set.
+
+    Counterpart of ``OptimizerResult.java``'s ``numInterBrokerReplicaMovements``
+    / ``dataToMoveMB`` / ``numIntraBrokerReplicaMovements`` /
+    ``intraBrokerDataToMoveMB`` / ``numLeadershipMovements`` — the cost side of
+    the rebalance that ``BalancingConstraint.java:24-41``'s thresholds exist to
+    bound and the executor throttles against (``ExecutionTaskPlanner.java:68``).
+    Data volumes are in DISK-load units (the ingest unit, MB in the reference).
+    """
+
+    num_inter_broker_moves: int = 0
+    num_intra_broker_moves: int = 0
+    num_leadership_moves: int = 0
+    inter_broker_data_to_move: float = 0.0
+    intra_broker_data_to_move: float = 0.0
+
+
+def movement_stats(initial: ClusterArrays, final: ClusterArrays) -> MovementStats:
+    """Diff two placements into movement volume (host-side, post-solve)."""
+    import numpy as np
+
+    valid = np.asarray(initial.replica_valid) & np.asarray(final.replica_valid)
+    b0 = np.asarray(initial.replica_broker)
+    b1 = np.asarray(final.replica_broker)
+    d0 = np.asarray(initial.replica_disk)
+    d1 = np.asarray(final.replica_disk)
+    disk_load = np.asarray(initial.base_load)[:, Resource.DISK]
+
+    inter = valid & (b0 != b1)
+    intra = valid & (b0 == b1) & (d0 != d1)
+    # partitions whose leader ends up on a different broker (the reference's
+    # hasLeaderAction criterion on the proposal diff, AnalyzerUtils.java:47)
+    l0 = np.asarray(initial.partition_leader)
+    l1 = np.asarray(final.partition_leader)
+    lead_moved = b0[l0] != b1[l1]
+
+    return MovementStats(
+        num_inter_broker_moves=int(inter.sum()),
+        num_intra_broker_moves=int(intra.sum()),
+        num_leadership_moves=int(lead_moved.sum()),
+        inter_broker_data_to_move=float(disk_load[inter].sum()),
+        intra_broker_data_to_move=float(disk_load[intra].sum()),
+    )
+
+
+@dataclasses.dataclass
 class OptimizerResult:
     """Counterpart of ``analyzer/OptimizerResult.java`` (320)."""
 
@@ -203,6 +251,10 @@ class OptimizerResult:
     provision: ProvisionRecommendation
     total_moves: int
     duration_s: float
+    movement: MovementStats = dataclasses.field(default_factory=MovementStats)
+    #: jitted-computation dispatches issued by this optimize() — the host↔device
+    #: round-trip budget that dominates wall-clock on a network-tunneled device
+    num_dispatches: int = 0
 
     @property
     def violated_hard_goals(self) -> List[str]:
@@ -227,8 +279,7 @@ class OptimizerResult:
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("round_fn", "max_rounds", "enable_heavy"))
-def _phase(state, ctx, prior_mask, admit_mask, *, round_fn, max_rounds, enable_heavy):
+def _phase_loop(state, ctx, prior_mask, admit_mask, *, round_fn, max_rounds, enable_heavy):
     """Drive one round type to convergence inside a single compiled while loop.
 
     ``prior_mask`` gates single-action acceptance (the hard "later goals never
@@ -266,6 +317,66 @@ def _phase(state, ctx, prior_mask, admit_mask, *, round_fn, max_rounds, enable_h
         cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(1))
     )
     return state, iters, total
+
+
+#: single-round-type phase (kept for targeted tests / ad-hoc drivers; the
+#: optimizer itself dispatches whole goals at a time via :func:`_goal_step`)
+_phase = partial(jax.jit, static_argnames=("round_fn", "max_rounds", "enable_heavy"))(
+    _phase_loop
+)
+
+
+@partial(jax.jit, static_argnames=("round_fns", "max_rounds", "enable_heavy"))
+def _goal_step(state, ctx, prior_mask, admit_mask, *, round_fns, max_rounds, enable_heavy):
+    """One goal = ONE device dispatch: every round-type phase of the goal run
+    to convergence back-to-back, then the full violations vector of the
+    resulting state — so the host never has to come back mid-goal.
+
+    This is the batched analogue of one iteration of the reference's per-goal
+    loop (GoalOptimizer.java:458-497: ``goal.optimize`` + stats bookkeeping in
+    a single pass).  Keeping the violations in the same executable means a
+    whole ``optimize()`` is ~(#goals + 3) dispatches instead of ~57, which is
+    what lets the async dispatch queue hide the tunnel latency of a remote
+    TPU: the host enqueues goal N+1 while the device still runs goal N.
+    """
+    rounds = jnp.int32(0)
+    moves = jnp.int32(0)
+    for fn in round_fns:
+        state, r, m = _phase_loop(
+            state, ctx, prior_mask, admit_mask,
+            round_fn=fn, max_rounds=max_rounds, enable_heavy=enable_heavy,
+        )
+        rounds += r
+        moves += m
+    snap = take_snapshot(state, ctx, enable_heavy)
+    return state, rounds, moves, G.violations_all(state, ctx, snap)
+
+
+@partial(jax.jit, static_argnames=("max_rf", "enable_heavy"))
+def _assigner_step(state, ctx, *, max_rf, enable_heavy):
+    """KafkaAssignerEvenRackAwareGoal as one dispatch: the constructive
+    even/rack-aware placement (analyzer.kafka_assigner) + trailing violations.
+    Replaces the improvement rounds entirely for this goal id — it is a full
+    placement mode, not a hill-climb (kafkaassigner/ package)."""
+    from cruise_control_tpu.analyzer.kafka_assigner import even_rack_aware_assign
+
+    state, moves = even_rack_aware_assign(state, ctx, max_rf=max_rf)
+    snap = take_snapshot(state, ctx, enable_heavy)
+    return state, jnp.int32(1), moves, G.violations_all(state, ctx, snap)
+
+
+def _max_replication_factor(state: ClusterArrays) -> int:
+    """Host-side maxRF (clusterModel.maxReplicationFactor) — static shape
+    parameter for the assigner's position loop."""
+    import numpy as np
+
+    valid = np.asarray(state.replica_valid)
+    if not valid.any():
+        return 1
+    counts = np.bincount(
+        np.asarray(state.replica_partition)[valid], minlength=state.num_partitions
+    )
+    return max(int(counts.max()), 1)
 
 
 @partial(jax.jit, static_argnames=("enable_heavy",))
@@ -311,13 +422,29 @@ class GoalOptimizer:
         ctx: GoalContext,
         maps=None,
         raise_on_hard_failure: bool = False,
+        profile_goals: bool = False,
     ) -> Tuple[ClusterArrays, OptimizerResult]:
+        """Run the goal list; one async device dispatch per goal.
+
+        The whole optimize is ~(#goals + 3) jitted dispatches with NO host
+        synchronization between goals (GoalOptimizer.java:458-497's one pass
+        over goals): every per-goal scalar (violations, rounds, moves) stays on
+        device until a single bulk fetch at the end, so on a network-tunneled
+        TPU the dispatch queue stays full.  ``profile_goals=True`` restores
+        accurate per-goal ``duration_s`` by blocking after each goal (the
+        per-goal durations the reference records in OptimizerResult.java) at
+        the cost of one round-trip per goal; otherwise per-goal durations
+        measure enqueue time only and the total ``duration_s`` is authoritative.
+        ``raise_on_hard_failure`` implies per-goal blocking for hard goals.
+        """
         from cruise_control_tpu.core.sensors import PROPOSAL_COMPUTATION_TIMER, REGISTRY
 
         t0 = time.monotonic()
         heavy = self.enable_heavy_goals
         initial = state
+        dispatches = 0
         viol0 = _violations(state, ctx, enable_heavy=heavy)
+        dispatches += 1
         stats_before = S.cluster_model_stats(state)
         no_prior = _mask_of(())
 
@@ -333,61 +460,78 @@ class GoalOptimizer:
         # The strict pass bounds cumulative admission by the hard goals (so the
         # repair lands feasibly when it can); the relaxed pass bounds nothing —
         # draining dead brokers beats transient overload (goals rebalance after).
+        # The relaxed pass's trailing violations vector doubles as the first
+        # goal's "before", so no standalone _violations dispatch is needed.
         hard_mask = _mask_of(tuple(g for g in self.hard_ids if g in self.goal_ids))
-        for fn, amask in ((offline_round, hard_mask), (offline_round_relaxed, no_prior)):
-            state, _, _ = _phase(
+        for fn, amask in (
+            ((offline_round,), hard_mask),
+            ((offline_round_relaxed,), no_prior),
+        ):
+            state, _, _, viol_cur = _goal_step(
                 state, ctx, no_prior, amask,
-                round_fn=fn, max_rounds=max_rounds, enable_heavy=heavy,
+                round_fns=fn, max_rounds=max_rounds, enable_heavy=heavy,
             )
+            dispatches += 1
 
-        reports: List[GoalReport] = []
+        raw: List[tuple] = []
         prior: Tuple[int, ...] = ()
-        total_moves = 0
-        # per-goal "before" reflects the post-offline-repair state; each goal's
-        # "after" vector doubles as the next goal's "before" (one dispatch per goal)
-        viol_cur = _violations(state, ctx, enable_heavy=heavy)
         for gid in self.goal_ids:
             g0 = time.monotonic()
-            before = float(viol_cur[gid])
             prior_mask = _mask_of(prior)
             admit_mask = _mask_of(prior + (gid,))
-            rounds = moves = 0
-            for round_fn in GOAL_ROUNDS[gid]:
-                state, r, m = _phase(
+            viol_prev = viol_cur
+            if gid == G.KAFKA_ASSIGNER_RACK:
+                # full placement mode, not an improvement loop (kafkaassigner/)
+                state, rounds, moves, viol_cur = _assigner_step(
+                    state, ctx,
+                    max_rf=_max_replication_factor(initial),
+                    enable_heavy=heavy,
+                )
+            else:
+                state, rounds, moves, viol_cur = _goal_step(
                     state, ctx, prior_mask, admit_mask,
-                    round_fn=round_fn,
+                    round_fns=GOAL_ROUNDS[gid],
                     max_rounds=max_rounds,
                     enable_heavy=heavy,
                 )
-                rounds += int(r)
-                moves += int(m)
-            viol_cur = _violations(state, ctx, enable_heavy=heavy)
-            after = float(viol_cur[gid])
+            dispatches += 1
             is_hard = gid in self.hard_ids
+            if profile_goals or (raise_on_hard_failure and is_hard):
+                jax.block_until_ready(viol_cur)
+            if raise_on_hard_failure and is_hard and float(viol_cur[gid]) > 0:
+                raise OptimizationFailure(
+                    f"{G.GOAL_NAMES[gid]} unsatisfied: "
+                    f"{float(viol_cur[gid]):.0f} violations remain"
+                )
+            raw.append((gid, viol_prev, viol_cur, rounds, moves, time.monotonic() - g0))
+            prior = prior + (gid,)
+
+        # single bulk host fetch of every per-goal scalar
+        violN = viol_cur
+        viol0_np, violN_np, fetched = jax.device_get(
+            (viol0, violN, [(vp, vc, r, m) for _, vp, vc, r, m, _ in raw])
+        )
+        reports: List[GoalReport] = []
+        total_moves = 0
+        for (gid, _, _, _, _, dur), (vp, vc, r, m) in zip(raw, fetched):
             reports.append(
                 GoalReport(
                     goal_id=gid,
                     name=G.GOAL_NAMES[gid],
-                    is_hard=is_hard,
-                    violations_before=before,
-                    violations_after=after,
-                    rounds=rounds,
-                    moves_applied=moves,
-                    duration_s=time.monotonic() - g0,
+                    is_hard=gid in self.hard_ids,
+                    violations_before=float(vp[gid]),
+                    violations_after=float(vc[gid]),
+                    rounds=int(r),
+                    moves_applied=int(m),
+                    duration_s=dur,
                 )
             )
-            total_moves += moves
-            if is_hard and after > 0 and raise_on_hard_failure:
-                raise OptimizationFailure(
-                    f"{G.GOAL_NAMES[gid]} unsatisfied: {after:.0f} violations remain"
-                )
-            prior = prior + (gid,)
+            total_moves += int(m)
 
-        violN = viol_cur
         names = G.GOAL_NAMES
         violated_hard = [
             names[g] for g in self.hard_ids
-            if g in self.goal_ids and float(violN[g]) > 0
+            if g in self.goal_ids and float(violN_np[g]) > 0
         ]
         provision = provision_verdict(state, ctx, violated_hard)
 
@@ -397,14 +541,16 @@ class GoalOptimizer:
 
         result = OptimizerResult(
             goal_reports=reports,
-            violations_before={names[g]: float(viol0[g]) for g in self.goal_ids},
-            violations_after={names[g]: float(violN[g]) for g in self.goal_ids},
+            violations_before={names[g]: float(viol0_np[g]) for g in self.goal_ids},
+            violations_after={names[g]: float(violN_np[g]) for g in self.goal_ids},
             stats_before=stats_before,
             stats_after=S.cluster_model_stats(state),
             proposals=proposals,
             provision=provision,
             total_moves=total_moves,
             duration_s=time.monotonic() - t0,
+            movement=movement_stats(initial, state),
+            num_dispatches=dispatches,
         )
         REGISTRY.timer(PROPOSAL_COMPUTATION_TIMER).update(result.duration_s)
         return state, result
