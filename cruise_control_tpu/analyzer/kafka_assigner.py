@@ -1,0 +1,171 @@
+"""Kafka-assigner compatibility mode: even, rack-aware full placement.
+
+Counterpart of ``analyzer/kafkaassigner/KafkaAssignerEvenRackAwareGoal.java`` —
+the migration-parity placement mode the reference keeps for kafka-assigner
+users.  Unlike every other goal (greedy improvement of an existing placement),
+this is a *constructive assignment*: walking replica positions 0..maxRF-1
+(position 0 = leader) and, per position, giving each partition's replica to the
+alive broker with the fewest replicas already assigned at that position
+(ties by lowest broker id — ``BrokerReplicaCount.compareTo``,
+KafkaAssignerEvenRackAwareGoal.java:496-504), skipping brokers whose rack
+already hosts a lower position of the same partition
+(``maybeApplyMove``:185-247).  The result is rack-aware by construction with
+per-position replica counts even across brokers — a materially different
+placement from what RackAwareGoal's mere rack-validity criterion would accept.
+
+TPU mapping: the reference's TreeSet walk is a sequential greedy whose state is
+just a per-broker count vector, so each position becomes one ``lax.scan`` over
+partitions with carry ``counts[B]`` — O(P·B) work per position on device, with
+the (count, id) argmin done as two overflow-safe reductions instead of a keyed
+sort.  Partitions are visited in canonical (topic, partition) order; the
+reference's order is HashMap-nondeterministic (``_partitionsByTopic``), so
+cross-implementation identity is per-position count *distribution*, not
+broker-for-broker placement.
+
+Excluded topics keep their placement and pre-seed the per-position counts
+(``initGoalState`` step 2, :89-104).  Dead brokers are never eligible
+destinations, so offline replicas drain as in the reference.  The
+rack-satisfiability sanity check (``ensureRackAwareSatisfiable``:318-343) is
+the caller's ``OptimizationFailure`` on residual violations — with fewer racks
+than maxRF some positions keep their (rack-violating) placement and the goal's
+violation count stays non-zero.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.model import arrays as A
+from cruise_control_tpu.model.arrays import ClusterArrays
+
+
+def replica_positions(state: ClusterArrays) -> jax.Array:
+    """i32[R]: position of each replica within its partition — leader 0,
+    followers 1.. in replica-row order (the reference's STEP1 leader-first
+    normalization, KafkaAssignerEvenRackAwareGoal.java:132-140)."""
+    R = state.num_replicas
+    lead = A.is_leader(state)
+    part = jnp.where(state.replica_valid, state.replica_partition, state.num_partitions)
+    # partition-major, leader-first, then stable row order
+    order = jnp.lexsort((jnp.arange(R), (~lead).astype(jnp.int32), part))
+    ps = part[order]
+    # rank within the partition group: index − first index of the group
+    rank = jnp.arange(R) - jnp.searchsorted(ps, ps, side="left")
+    pos = jnp.zeros(R, jnp.int32).at[order].set(rank.astype(jnp.int32))
+    return jnp.where(state.replica_valid, pos, -1)
+
+
+def _assign_position(
+    counts, chosen, p, rf, excluded_part, broker_rack, eligible,
+):
+    """One position pass: scan partitions, assigning each a destination broker.
+
+    counts: i32[B] replicas already assigned to each broker at this position
+    chosen: i32[P, maxRF] brokers picked so far (-1 = unassigned); columns < p
+            define the rack- AND broker-exclusion sets for this pass
+    eligible: bool[B] destination eligibility (alive ∧ not move-excluded,
+            ∧ not leadership-excluded for position 0)
+
+    When every rack is exhausted (fewer usable racks than maxRF — the state the
+    reference fails fast on, ``ensureRackAwareSatisfiable``:318-343) the pass
+    falls back to ignoring the rack constraint but NEVER the same-broker
+    constraint, so the no-duplicate-replica invariant holds and the residual
+    rack violation surfaces through the goal's violation count instead.
+    """
+    B = counts.shape[0]
+    ids = jnp.arange(B, dtype=jnp.int32)
+    prev = chosen[:, :p] if p else jnp.full((chosen.shape[0], 0), -1, jnp.int32)
+    prev_racks = jnp.where(prev >= 0, broker_rack[jnp.maximum(prev, 0)], -1)
+
+    def step(counts, xs):
+        pr_racks, pr_brokers, has_pos = xs
+        if pr_racks.shape[0]:
+            inel_rack = (broker_rack[None, :] == pr_racks[:, None]).any(axis=0)
+            inel_broker = (ids[None, :] == pr_brokers[:, None]).any(axis=0)
+        else:
+            inel_rack = inel_broker = jnp.zeros(B, bool)
+
+        big = jnp.int32(2**31 - 1)
+
+        def argmin_count(mask):
+            # lexicographic (count, id) argmin without overflow: min count
+            # first, then min id among brokers at that count
+            c = jnp.where(mask, counts, big)
+            cmin = c.min()
+            b = jnp.where(mask & (counts == cmin), ids, big).min().astype(jnp.int32)
+            return b, cmin < big
+
+        strict = eligible & ~inel_rack & ~inel_broker
+        relaxed = eligible & ~inel_broker
+        b1, ok1 = argmin_count(strict)
+        b2, ok2 = argmin_count(relaxed)
+        b = jnp.where(ok1, b1, b2)
+        ok = has_pos & (ok1 | ok2)
+        counts = jnp.where(ok, counts.at[b].add(1), counts)
+        return counts, jnp.where(ok, b, -1)
+
+    has = (rf > p) & ~excluded_part
+    counts, picks = jax.lax.scan(step, counts, (prev_racks, prev, has))
+    return counts, chosen.at[:, p].set(picks)
+
+
+@partial(jax.jit, static_argnames=("max_rf",))
+def even_rack_aware_assign(state: ClusterArrays, ctx, *, max_rf: int):
+    """The full placement mode: returns (new_state, num_moves).
+
+    Leadership lands on the position-0 broker (the reference moves leadership
+    during position-0 assignment via LEADERSHIP_MOVEMENT, :216-218); since the
+    leader replica row *is* position 0 (``replica_positions``), the
+    ``partition_leader`` index array is unchanged and only brokers move.
+    """
+    P, B = state.num_partitions, state.num_brokers
+    pos = replica_positions(state)
+    valid = state.replica_valid
+    rf = jnp.zeros(P, jnp.int32).at[state.replica_partition].add(
+        valid.astype(jnp.int32)
+    )
+    excluded_part = ctx.excluded_topics[state.partition_topic]
+
+    # pre-seed per-position counts with excluded replicas (initGoalState:89-104)
+    excluded_rep = valid & excluded_part[state.replica_partition]
+    chosen = jnp.full((P, max_rf), -1, jnp.int32)
+    # destination eligibility: alive ∧ not excluded-for-replica-move; position
+    # 0 carries leadership, so leadership-excluded brokers are barred there.
+    # (The reference rejects these options outright in kafka-assigner mode —
+    # KafkaAssignerUtils.sanityCheckOptimizationOptions; honoring them is the
+    # strictly-safer behavior.)
+    move_ok = state.broker_alive & ~ctx.excluded_for_replica_move
+    for p in range(max_rf):
+        at_p = excluded_rep & (pos == p)
+        counts = jnp.zeros(B, jnp.int32).at[state.replica_broker].add(
+            at_p.astype(jnp.int32)
+        )
+        eligible = move_ok & ~ctx.excluded_for_leadership if p == 0 else move_ok
+        counts, chosen = _assign_position(
+            counts, chosen, p, rf, excluded_part, state.broker_rack, eligible,
+        )
+
+    pick = chosen[state.replica_partition, jnp.clip(pos, 0, max_rf - 1)]
+    movable = valid & (pos >= 0) & (pick >= 0)
+    new_broker = jnp.where(movable, pick, state.replica_broker)
+    moves = (new_broker != state.replica_broker).sum().astype(jnp.int32)
+
+    new_state = state.replace(replica_broker=new_broker)
+    if state.num_disks:
+        # JBOD: moved replicas land on the first alive disk of the destination
+        # broker (intra-broker balance is KafkaAssignerDiskUsageDistributionGoal's
+        # job, run after this mode)
+        disk_ids = jnp.arange(state.num_disks, dtype=jnp.int32)
+        big = jnp.int32(2**31 - 1)
+        # lowest alive disk id per broker: scatter-min (big = no alive disk)
+        first_alive = jnp.full(B, big).at[state.disk_broker].min(
+            jnp.where(state.disk_alive, disk_ids, big), mode="drop"
+        )
+        first_alive = jnp.where(first_alive == big, -1, first_alive)
+        moved = new_broker != state.replica_broker
+        new_disk = jnp.where(moved, first_alive[new_broker], state.replica_disk)
+        new_state = new_state.replace(replica_disk=new_disk)
+    return new_state, moves

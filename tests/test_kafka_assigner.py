@@ -1,0 +1,175 @@
+"""KafkaAssignerEvenRackAwareGoal parity tests.
+
+The mode's contract (kafkaassigner/KafkaAssignerEvenRackAwareGoal.java): a full
+constructive placement — per replica position, counts even across alive brokers
+(TreeSet of (count, id), :474-522) under rack exclusion of earlier positions
+(:185-247) — NOT merely rack-validity.  The pivotal fixture here is already
+rack-aware, so RackAwareGoal's criterion alone would accept the unbalanced
+placement unchanged; the even mode must still spread it.
+"""
+
+import numpy as np
+
+from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.kafka_assigner import replica_positions
+from cruise_control_tpu.model import arrays as A
+from tests import fixtures
+
+
+def _piled_but_rack_aware():
+    """6 brokers over 3 racks; 6 RF-2 partitions ALL on brokers 0 (leader,
+    rack 0) and 1 (follower, rack 1) — rack-aware (distinct racks) yet
+    maximally uneven."""
+    cluster = fixtures.homogeneous_cluster(fixtures.RACK_BY_BROKER4)
+    for p in range(6):
+        cluster.create_replica(0, ("T1", p), 0, True)
+        cluster.create_replica(1, ("T1", p), 1, False)
+        cluster.set_replica_load(0, ("T1", p), fixtures.load(5.0, 100.0, 10.0, 75.0))
+        cluster.set_replica_load(1, ("T1", p), fixtures.load(1.0, 100.0, 0.0, 75.0))
+    return cluster.to_arrays()
+
+
+def _rack_of_brokers(state):
+    return np.asarray(state.broker_rack)
+
+
+def _position_counts(state, position):
+    pos = np.asarray(replica_positions(state))
+    brokers = np.asarray(state.replica_broker)
+    valid = np.asarray(state.replica_valid)
+    sel = valid & (pos == position)
+    return np.bincount(brokers[sel], minlength=state.num_brokers)
+
+
+class TestReplicaPositions:
+    def test_leader_is_position_zero(self):
+        state, _ = _piled_but_rack_aware()
+        pos = np.asarray(replica_positions(state))
+        lead = np.asarray(A.is_leader(state))
+        valid = np.asarray(state.replica_valid)
+        assert (pos[valid & lead] == 0).all()
+        assert (pos[valid & ~lead] > 0).all()
+
+
+class TestEvenRackAwareMode:
+    def test_spreads_what_rack_awareness_alone_would_accept(self):
+        state, maps = _piled_but_rack_aware()
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+
+        # pivotal precondition: plain rack-awareness is already satisfied, so
+        # RackAwareGoal's criterion (goals_base alias) sees zero violations
+        from cruise_control_tpu.analyzer.context import take_snapshot
+        from cruise_control_tpu.analyzer.goals_base import violations_all
+
+        snap = take_snapshot(state, ctx, True)
+        assert float(violations_all(state, ctx, snap)[G.RACK_AWARE]) == 0.0
+
+        opt = GoalOptimizer(
+            goal_ids=(G.KAFKA_ASSIGNER_RACK,),
+            hard_ids=(G.KAFKA_ASSIGNER_RACK,),
+        )
+        final, result = opt.optimize(state, ctx)
+
+        # the mode moved replicas despite zero rack violations...
+        assert result.total_moves > 0
+        # ...to an even per-position distribution: 6 partitions / 6 brokers
+        # → exactly one leader and one follower per broker
+        assert (_position_counts(final, 0) == 1).all()
+        assert (_position_counts(final, 1) == 1).all()
+        # ...still rack-aware: each partition's two brokers on distinct racks
+        racks = _rack_of_brokers(final)
+        part = np.asarray(final.replica_partition)
+        brokers = np.asarray(final.replica_broker)
+        valid = np.asarray(final.replica_valid)
+        for p in range(final.num_partitions):
+            rs = racks[brokers[valid & (part == p)]]
+            assert len(set(rs.tolist())) == len(rs)
+        # hard goal satisfied in the report
+        assert not result.violated_hard_goals
+
+    def test_drains_dead_broker(self):
+        state, maps = _piled_but_rack_aware()
+        import jax.numpy as jnp
+
+        alive = np.asarray(state.broker_alive).copy()
+        alive[0] = False
+        state = state.replace(broker_alive=jnp.asarray(alive))
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        opt = GoalOptimizer(
+            goal_ids=(G.KAFKA_ASSIGNER_RACK,),
+            hard_ids=(G.KAFKA_ASSIGNER_RACK,),
+        )
+        final, _ = opt.optimize(state, ctx)
+        brokers = np.asarray(final.replica_broker)
+        valid = np.asarray(final.replica_valid)
+        assert (brokers[valid] != 0).all(), "dead broker 0 must be drained"
+
+    def test_rack_exhaustion_never_duplicates_replicas(self):
+        """RF > racks (the state the reference fails fast on,
+        ensureRackAwareSatisfiable): the fallback may violate rack-awareness
+        (surfaced as a hard-goal violation) but must NEVER put two replicas of
+        a partition on one broker."""
+        from cruise_control_tpu.synthetic import SyntheticSpec, generate
+
+        state, _ = generate(
+            SyntheticSpec(
+                num_racks=2, num_brokers=6, num_topics=4, num_partitions=40,
+                replication_factor=3, seed=3, skew_brokers=2,
+            )
+        )
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        opt = GoalOptimizer(
+            goal_ids=(G.KAFKA_ASSIGNER_RACK,),
+            hard_ids=(G.KAFKA_ASSIGNER_RACK,),
+        )
+        final, result = opt.optimize(state, ctx)
+        rp = np.asarray(final.replica_partition)
+        rb = np.asarray(final.replica_broker)
+        valid = np.asarray(final.replica_valid)
+        keys = rp[valid].astype(np.int64) * final.num_brokers + rb[valid]
+        assert len(np.unique(keys)) == int(valid.sum()), "duplicate replica"
+        # 2 racks / RF 3: rack-awareness is unsatisfiable — reported, not hidden
+        assert result.violated_hard_goals
+
+    def test_excluded_destination_brokers_receive_nothing(self):
+        state, _ = _piled_but_rack_aware()
+        ctx = GoalContext.build(
+            state.num_topics, state.num_brokers,
+            excluded_brokers_for_replica_move=(5,),
+        )
+        opt = GoalOptimizer(
+            goal_ids=(G.KAFKA_ASSIGNER_RACK,),
+            hard_ids=(G.KAFKA_ASSIGNER_RACK,),
+        )
+        final, _ = opt.optimize(state, ctx)
+        rb = np.asarray(final.replica_broker)
+        valid = np.asarray(final.replica_valid)
+        b0 = np.asarray(state.replica_broker)
+        landed = valid & (rb == 5) & (b0 != 5)
+        assert not landed.any(), "move-excluded broker received replicas"
+
+    def test_excluded_topics_stay_put(self):
+        cluster = fixtures.homogeneous_cluster(fixtures.RACK_BY_BROKER4)
+        for p in range(4):
+            cluster.create_replica(0, ("T1", p), 0, True)
+            cluster.create_replica(1, ("T1", p), 1, False)
+        for p in range(4):
+            cluster.create_replica(2, ("T2", p), 0, True)
+            cluster.create_replica(4, ("T2", p), 1, False)
+        state, maps = cluster.to_arrays()
+        t1 = maps.topic_index["T1"]
+        ctx = GoalContext.build(
+            state.num_topics, state.num_brokers, excluded_topic_ids=(t1,)
+        )
+        before = np.asarray(state.replica_broker).copy()
+        opt = GoalOptimizer(
+            goal_ids=(G.KAFKA_ASSIGNER_RACK,),
+            hard_ids=(G.KAFKA_ASSIGNER_RACK,),
+        )
+        final, _ = opt.optimize(state, ctx)
+        after = np.asarray(final.replica_broker)
+        topic = np.asarray(state.partition_topic)[np.asarray(state.replica_partition)]
+        valid = np.asarray(state.replica_valid)
+        excl = valid & (topic == t1)
+        assert (before[excl] == after[excl]).all(), "excluded topic must not move"
