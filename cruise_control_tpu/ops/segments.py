@@ -50,10 +50,13 @@ MAX_COLS = _C - 1
 #: below this many segment elements the scatter-add is fine and the one-hot
 #: matmul's padding overhead dominates — stay on the XLA path
 MIN_PALLAS_ELEMS = 16_384
-#: above this many segments the one-hot formulation re-reads the replica axis
-#: (segments/TB) times and loses to the scatter (measured 0.35× at B=10k on
-#: v5e) — those shapes stay on the XLA path
+#: above this many segments the FLAT one-hot's R·B compare work loses to the
+#: scatter (measured 0.35× at B=10k on v5e) — those shapes go to the radix
+#: kernel instead (R·(B/128 + 128) compares)
 MAX_PALLAS_SEGMENTS = 2_048
+#: radix-kernel ceiling: beyond this the [C·H, TR] staging tile outgrows VMEM
+#: at TR=2048 (B=16k, C=7 → ~8 MB); larger B would need a narrower replica tile
+MAX_RADIX_SEGMENTS = 16_384
 
 
 def _seg_kernel(vals_ref, out_ref):
@@ -135,6 +138,103 @@ def segment_sum_pallas(
     return out[:, 0] if squeeze else out
 
 
+# -- large-B radix kernel -----------------------------------------------------------
+#
+# Above ~2k segments the flat one-hot's VPU work (R·B compares) loses to the
+# scatter.  Factorize the segment id into radix digits ``seg = hi·_L + lo``
+# (_L = 128 lanes): building one-hots for each digit costs R·(H + L) compares
+# (H = ⌈B/128⌉ — 50× less at B=10k), and the per-broker sums come back as ONE
+# MXU contraction  A[c·H+h, r] · onehot_lo[r, l] → out[c·H+h, l] ≅ out[c, b]
+# where A[c·H+h, r] = values[c, r] · (hi_r == h).  One pass over the replica
+# axis, output block resident in VMEM across the whole grid — the canonical
+# reduction layout.  This covers the north-star broker count (B = 10k,
+# ClusterModel.java:1332 hot path) where the flat kernel is inapplicable.
+
+#: lo-digit radix == lane width of the output tile
+_L = 128
+
+
+def _seg_radix_kernel(vals_ref, out_ref, *, n_cols, n_hi):
+    """One grid step: accumulate the radix-factorized one-hot contraction of a
+    [_C, _TR] replica tile into the [n_cols·n_hi, _L] output block."""
+    j = pl.program_id(0)
+
+    tile = vals_ref[...]                                # f32[_C, _TR]
+    seg = tile[_C - 1 : _C, :].astype(jnp.int32)        # i32[1, _TR]
+    hi = seg // _L                                      # i32[1, _TR]
+    lo = seg - hi * _L
+
+    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (n_hi, _TR), dimension=0)
+    onehot_hi = (hi == hi_iota).astype(jnp.float32)     # f32[n_hi, _TR]
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (_TR, _L), dimension=1)
+    onehot_lo = (lo.T == lo_iota).astype(jnp.float32)   # f32[_TR, _L]
+
+    # A[c, h, r] = values[c, r] · onehot_hi[h, r] — leading-dim merge is a
+    # layout no-op (lane dim _TR untouched)
+    a = tile[:n_cols, None, :] * onehot_hi[None, :, :]
+    a = a.reshape(n_cols * n_hi, _TR)
+
+    acc = jax.lax.dot_general(
+        a,
+        onehot_lo,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )                                                   # f32[n_cols·n_hi, _L]
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += acc
+
+
+@partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def segment_sum_radix(
+    values: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Radix-factorized segment sum for large segment counts (B > 2048).
+
+    Same contract as :func:`segment_sum_pallas`; one pass over the replica
+    axis regardless of ``num_segments``.
+    """
+    squeeze = values.ndim == 1
+    if squeeze:
+        values = values[:, None]
+    R, C = values.shape
+    if C > MAX_COLS:
+        raise ValueError(f"segment_sum_radix supports ≤ {MAX_COLS} columns, got {C}")
+    Rp = _pad_to(max(R, 1), _TR)
+    # hi digits, padded so (a) C·Hp is sublane-aligned and (b) at least one
+    # padded slot ≥ num_segments exists for out-of-range ids to land in
+    Hp = _pad_to((num_segments + 1 + _L - 1) // _L, 8)
+    sink = Hp * _L - 1                                  # ≥ num_segments by (b)
+
+    seg = segment_ids.astype(jnp.int32)
+    seg = jnp.where((seg < 0) | (seg >= num_segments), sink, seg)
+
+    packed = jnp.zeros((_C, Rp), jnp.float32)
+    packed = packed.at[:C, :R].set(values.astype(jnp.float32).T)
+    packed = packed.at[_C - 1, :R].set(seg.astype(jnp.float32))
+    packed = packed.at[_C - 1, R:].set(jnp.float32(sink))
+
+    out = pl.pallas_call(
+        partial(_seg_radix_kernel, n_cols=C, n_hi=Hp),
+        grid=(Rp // _TR,),
+        in_specs=[
+            pl.BlockSpec((_C, _TR), lambda j: (0, j), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((C * Hp, _L), lambda j: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((C * Hp, _L), jnp.float32),
+        interpret=interpret,
+    )(packed)
+    out = out.reshape(C, Hp * _L)[:, :num_segments].T   # [num_segments, C]
+    return out[:, 0] if squeeze else out
+
+
 def _tpu_backend() -> bool:
     """True on real TPU backends — including the tunneled accelerator, whose
     experimental PJRT plugin may register as platform 'axon'."""
@@ -145,7 +245,7 @@ def _use_pallas(n_elems: int, num_segments: int) -> bool:
     flag = os.environ.get("CC_TPU_PALLAS_SEGMENTS", "1")
     if flag == "0":
         return False
-    if num_segments > MAX_PALLAS_SEGMENTS:
+    if num_segments > MAX_RADIX_SEGMENTS:
         return False
     if flag == "force":
         return True
@@ -168,9 +268,12 @@ def segment_sum(
         # interpret mode only off-TPU (CPU tests with CC_TPU_PALLAS_SEGMENTS=
         # force); on the accelerator the kernel must compile, never interpret
         interpret = not _tpu_backend()
-        out = segment_sum_pallas(
-            values, segment_ids, num_segments, interpret=interpret
+        kernel = (
+            segment_sum_pallas
+            if num_segments <= MAX_PALLAS_SEGMENTS
+            else segment_sum_radix
         )
+        out = kernel(values, segment_ids, num_segments, interpret=interpret)
         if not jnp.issubdtype(values.dtype, jnp.floating):
             out = jnp.round(out).astype(values.dtype)
         else:

@@ -13,6 +13,7 @@ from cruise_control_tpu.ops.segments import (
     MAX_COLS,
     segment_sum,
     segment_sum_pallas,
+    segment_sum_radix,
 )
 
 
@@ -40,6 +41,37 @@ def test_segment_sum_pallas_1d_and_int():
     ones = jnp.ones(300, jnp.float32)
     got = segment_sum_pallas(ones, seg, 17, interpret=True)
     want = jax.ops.segment_sum(ones, seg, num_segments=17)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("R,B,C", [(64, 2500, 3), (700, 4000, 7), (300, 3000, 1)])
+def test_segment_sum_radix_matches_xla(R, B, C):
+    """Large-B radix factorization (B > 2048 — the flat kernel's ceiling)."""
+    rng = np.random.default_rng(R + B + C)
+    vals = jnp.asarray(rng.normal(size=(R, C)).astype(np.float32))
+    seg = jnp.asarray(rng.integers(0, B, size=R).astype(np.int32))
+    got = segment_sum_radix(vals, seg, B, interpret=True)
+    want = jax.ops.segment_sum(vals, seg, num_segments=B)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_segment_sum_radix_drops_out_of_range():
+    vals = jnp.ones((12, 2), jnp.float32)
+    seg = jnp.asarray(
+        [0, 1, 2500, 3000, -1, 9999, 4, 4, 2, -7, 2048, 2049], jnp.int32
+    )
+    got = segment_sum_radix(vals, seg, 2600, interpret=True)
+    want = jax.ops.segment_sum(vals, seg, num_segments=2600)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_segment_sum_radix_1d_squeeze():
+    rng = np.random.default_rng(3)
+    seg = jnp.asarray(rng.integers(0, 3001, size=400).astype(np.int32))
+    ones = jnp.ones(400, jnp.float32)
+    got = segment_sum_radix(ones, seg, 3001, interpret=True)
+    want = jax.ops.segment_sum(ones, seg, num_segments=3001)
+    assert got.shape == want.shape
     np.testing.assert_allclose(np.asarray(got), np.asarray(want))
 
 
