@@ -1,92 +1,194 @@
 #!/usr/bin/env python
 """Scale benchmarks beyond the flagship bench.py config.
 
-Runs BASELINE.md config #3 (1k brokers / 100k partitions, add/remove-broker style
-skew, RackAware + ReplicaCapacity + capacity goals) and prints one JSON line per
-config.  Not wired into the driver's bench.py contract — used to track the
-scale-out solver milestones (SURVEY §7 step 5).
+BASELINE.md configs #3 and #4:
 
-Usage: python bench_scale.py [--cpu] [--full-goals]
+* ``--config3`` — 1k brokers / 100k partitions, capacity-goal subset (default)
+* ``--config4`` — the north star: 10k brokers / 1M partitions / 3M replicas,
+  full default goal list with heavy [B,T] goals ON plus the JBOD intra-broker
+  goals, per-logdir capacities shaped like ``config/capacityJBOD.json``
+
+Prints one JSON line, and with ``--out FILE`` writes the full artifact
+(per-goal rounds/violations/durations, movement volume, dispatch count) the
+way the reference self-measures through its proposal-computation-timer and
+per-goal durations (GoalOptimizer.java:84,457,474).
+
+Usage: python bench_scale.py [--config4] [--cpu] [--profile] [--max-active N]
+                             [--no-warmup] [--out FILE] [--brokers N] [--partitions N]
 """
 
 import argparse
+import dataclasses
 import json
-import sys
 import time
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    ap.add_argument("--config4", action="store_true",
+                    help="north-star preset: 10k brokers / 1M partitions, all goals, JBOD")
     ap.add_argument("--full-goals", action="store_true", help="run all 16 goals")
-    ap.add_argument("--brokers", type=int, default=1000)
-    ap.add_argument("--partitions", type=int, default=100_000)
+    ap.add_argument("--brokers", type=int, default=None)
+    ap.add_argument("--partitions", type=int, default=None)
+    ap.add_argument("--max-active", type=int, default=None,
+                    help="GoalContext.max_active_brokers (per-round source window)")
+    ap.add_argument("--profile", action="store_true",
+                    help="block per goal for accurate per-goal durations")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the warm-up run (reported wall includes compile)")
+    ap.add_argument("--out", type=str, default=None,
+                    help="write the full per-goal artifact JSON here")
     args = ap.parse_args()
 
     if args.cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
     else:
         # dead-tunnel guard: fall back to CPU instead of blocking ~25 min in
         # in-process backend init (shared bench.py helper)
         from bench import ensure_live_backend
 
-        ensure_live_backend()
+        platform = ensure_live_backend()
 
     from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
     from cruise_control_tpu.analyzer import goals_base as G
     from cruise_control_tpu.synthetic import SyntheticSpec, generate
 
-    spec = SyntheticSpec(
-        num_racks=20,
-        num_brokers=args.brokers,
-        num_topics=1000,
-        num_partitions=args.partitions,
-        replication_factor=3,
-        distribution="exponential",
-        skew_brokers=args.brokers // 4,
-        mean_cpu=0.25,
-        mean_disk=0.2,
-        mean_nw_in=0.15,
-        mean_nw_out=0.15,
-        seed=11,
-    )
-    state, maps = generate(spec)
-    ctx = GoalContext.build(state.num_topics, state.num_brokers)
-    goal_ids = (
-        G.DEFAULT_GOAL_ORDER
-        if args.full_goals
-        else (
-            G.RACK_AWARE,
-            G.REPLICA_CAPACITY,
-            G.DISK_CAPACITY,
-            G.NW_IN_CAPACITY,
-            G.NW_OUT_CAPACITY,
-            G.CPU_CAPACITY,
+    if args.config4:
+        brokers = args.brokers or 10_000
+        partitions = args.partitions or 1_000_000
+        # capacityJBOD.json: two 500k logdirs, CPU 100, NW 100k
+        spec = SyntheticSpec(
+            num_racks=40,
+            num_brokers=brokers,
+            num_topics=2_000,
+            num_partitions=partitions,
+            replication_factor=3,
+            distribution="exponential",
+            skew_brokers=brokers // 4,
+            mean_cpu=0.25,
+            mean_disk=0.2,
+            mean_nw_in=0.15,
+            mean_nw_out=0.15,
+            capacity_cpu=100.0,
+            capacity_disk=1_000_000.0,
+            capacity_nw_in=100_000.0,
+            capacity_nw_out=100_000.0,
+            disks_per_broker=2,
+            build_maps=False,
+            seed=11,
         )
-    )
-    opt = GoalOptimizer(goal_ids=goal_ids, enable_heavy_goals=args.full_goals)
-    opt.optimize(state, ctx)                      # compile warm-up
+        goal_ids = tuple(G.DEFAULT_GOAL_ORDER) + (
+            G.INTRA_DISK_CAPACITY,
+            G.INTRA_DISK_USAGE_DIST,
+        )
+        heavy = True
+    else:
+        brokers = args.brokers or 1_000
+        partitions = args.partitions or 100_000
+        spec = SyntheticSpec(
+            num_racks=20,
+            num_brokers=brokers,
+            num_topics=1000,
+            num_partitions=partitions,
+            replication_factor=3,
+            distribution="exponential",
+            skew_brokers=brokers // 4,
+            mean_cpu=0.25,
+            mean_disk=0.2,
+            mean_nw_in=0.15,
+            mean_nw_out=0.15,
+            seed=11,
+            build_maps=False,
+        )
+        goal_ids = (
+            tuple(G.DEFAULT_GOAL_ORDER)
+            if args.full_goals
+            else (
+                G.RACK_AWARE,
+                G.REPLICA_CAPACITY,
+                G.DISK_CAPACITY,
+                G.NW_IN_CAPACITY,
+                G.NW_OUT_CAPACITY,
+                G.CPU_CAPACITY,
+            )
+        )
+        heavy = args.full_goals
+
+    t_gen = time.monotonic()
+    state, _ = generate(spec)
+    gen_s = time.monotonic() - t_gen
+
+    ctx_kw = {}
+    if args.max_active is not None:
+        ctx_kw["max_active_brokers"] = args.max_active
+    ctx = GoalContext.build(state.num_topics, state.num_brokers, **ctx_kw)
+
+    opt = GoalOptimizer(goal_ids=goal_ids, enable_heavy_goals=heavy)
+    compile_s = None
+    if not args.no_warmup:
+        t0 = time.monotonic()
+        opt.optimize(state, ctx)
+        compile_s = time.monotonic() - t0
     t0 = time.monotonic()
-    final, result = opt.optimize(state, ctx)
+    final, result = opt.optimize(state, ctx, profile_goals=args.profile)
     wall = time.monotonic() - t0
+
     residual_hard = sum(
         result.violations_after[name] for name in result.violated_hard_goals
     )
-    print(
-        json.dumps(
+    line = {
+        "metric": f"rebalance_wall_s_{brokers}brokers_{partitions}partitions",
+        "value": round(wall, 3),
+        "unit": "s",
+        "residual_hard_violations": residual_hard,
+        "total_moves": result.total_moves,
+        "total_rounds": sum(r.rounds for r in result.goal_reports),
+        "inter_broker_moves": result.movement.num_inter_broker_moves,
+        "data_to_move": round(result.movement.inter_broker_data_to_move, 3),
+        "num_dispatches": result.num_dispatches,
+        "goals": len(goal_ids),
+        "provision": result.provision.status,
+        "balancedness": round(result.balancedness_score, 4),
+        "platform": platform,
+    }
+    if compile_s is not None:
+        line["first_run_s"] = round(compile_s, 3)
+    print(json.dumps(line))
+
+    if args.out:
+        artifact = dict(line)
+        artifact.update(
             {
-                "metric": f"rebalance_wall_s_{args.brokers}brokers_{args.partitions}partitions",
-                "value": round(wall, 3),
-                "unit": "s",
-                "residual_hard_violations": residual_hard,
-                "total_moves": result.total_moves,
-                "goals": len(goal_ids),
-                "provision": result.provision.status,
+                "spec": {
+                    k: v
+                    for k, v in dataclasses.asdict(spec).items()
+                    if not isinstance(v, (list, dict))
+                },
+                "generate_s": round(gen_s, 3),
+                "max_active_brokers": int(ctx.max_active_brokers),
+                "violations_before": result.violations_before,
+                "violations_after": result.violations_after,
+                "movement": dataclasses.asdict(result.movement),
+                "goal_reports": [
+                    {
+                        "name": r.name,
+                        "hard": r.is_hard,
+                        "rounds": r.rounds,
+                        "moves": r.moves_applied,
+                        "violations_before": r.violations_before,
+                        "violations_after": r.violations_after,
+                        "duration_s": round(r.duration_s, 3),
+                    }
+                    for r in result.goal_reports
+                ],
             }
         )
-    )
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -50,6 +50,13 @@ class SyntheticSpec:
     seed: int = 0
     #: place all replicas skewed onto the first ``skew_brokers`` brokers (0 = spread)
     skew_brokers: int = 0
+    #: JBOD: logdirs per broker (0 = single-logdir, no disk axis) — the
+    #: capacityJBOD.json shape; per-disk capacity = capacity_disk / disks
+    disks_per_broker: int = 0
+    #: skip the name/index dictionaries (IndexMaps) — at 3M replicas the Python
+    #: tuple lists cost ~GBs and minutes; benchmarks that never emit proposals
+    #: don't need them
+    build_maps: bool = True
 
 
 def _partition_loads(rng: np.random.Generator, spec: SyntheticSpec, n: int) -> np.ndarray:
@@ -149,10 +156,26 @@ def generate(spec: SyntheticSpec):
         (B, 1),
     )
 
+    dpb = spec.disks_per_broker
+    if dpb > 0:
+        D = B * dpb
+        disk_broker = np.repeat(np.arange(B, dtype=np.int32), dpb)
+        disk_capacity = np.full(D, spec.capacity_disk / dpb, np.float32)
+        disk_alive = np.ones(D, bool)
+        # skew within the broker too: uneven logdir fill for the intra goals
+        local = rng.integers(0, dpb, size=R).astype(np.int32)
+        replica_disk = replica_broker * dpb + local
+    else:
+        D = 0
+        disk_broker = np.zeros(0, np.int32)
+        disk_capacity = np.zeros(0, np.float32)
+        disk_alive = np.zeros(0, bool)
+        replica_disk = np.full(R, -1, np.int32)
+
     state = ClusterArrays(
         replica_partition=jnp.asarray(replica_partition),
         replica_broker=jnp.asarray(replica_broker),
-        replica_disk=jnp.full(R, -1, jnp.int32),
+        replica_disk=jnp.asarray(replica_disk),
         replica_valid=jnp.ones(R, bool),
         base_load=jnp.asarray(base_load),
         original_broker=jnp.asarray(replica_broker),
@@ -165,13 +188,16 @@ def generate(spec: SyntheticSpec):
         broker_alive=jnp.ones(B, bool),
         broker_new=jnp.zeros(B, bool),
         broker_demoted=jnp.zeros(B, bool),
-        disk_broker=jnp.zeros(0, jnp.int32),
-        disk_capacity=jnp.zeros(0, jnp.float32),
-        disk_alive=jnp.zeros(0, bool),
+        disk_broker=jnp.asarray(disk_broker),
+        disk_capacity=jnp.asarray(disk_capacity),
+        disk_alive=jnp.asarray(disk_alive),
         num_racks=spec.num_racks,
         num_topics=spec.num_topics,
         num_hosts=B,
     )
+
+    if not spec.build_maps:
+        return state, None
 
     topic_names = [f"T{t}" for t in range(spec.num_topics)]
     partitions = [(topic_names[partition_topic[p]], int(p)) for p in range(P)]
@@ -189,7 +215,11 @@ def generate(spec: SyntheticSpec):
         replicas=[
             (partitions[replica_partition[i]], int(replica_broker[i])) for i in range(R)
         ],
-        disks=[],
-        disk_index={},
+        disks=[(b, f"/logdir{k}") for b in range(B) for k in range(dpb)],
+        disk_index={
+            (b, f"/logdir{k}"): b * dpb + k
+            for b in range(B)
+            for k in range(dpb)
+        },
     )
     return state, maps
