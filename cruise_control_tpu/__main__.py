@@ -1,11 +1,33 @@
 """``python -m cruise_control_tpu --config cruisecontrol.properties``
 
 The process entry point (KafkaCruiseControlMain.java:26).
+
+The backend must be resolved BEFORE ``cruise_control_tpu.app`` is imported:
+the app's import chain creates module-scope device constants, and with a dead
+accelerator tunnel that first backend touch blocks ~25 minutes inside backend
+init — main() would never be reached.  Help/doc invocations never need the
+accelerator, so they skip the probe and pin the CPU platform outright;
+serving invocations pay one probe (``CC_TPU_PROBE_TIMEOUT_S`` tunes it).
+``backend_probe`` imports only stdlib, so running it here is safe.
 """
 
 import sys
 
-from cruise_control_tpu.app import main
+_NO_ACCELERATOR_FLAGS = {"-h", "--help", "--print-config-docs"}
+
+if _NO_ACCELERATOR_FLAGS & set(sys.argv[1:]):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+else:
+    from cruise_control_tpu.core.backend_probe import ensure_live_backend
+
+    print(
+        f"cruise-control-tpu backend platform: {ensure_live_backend()}",
+        flush=True,
+    )
+
+from cruise_control_tpu.app import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -326,6 +326,13 @@ def main(argv=None) -> int:
         print(cruise_control_config().doc_table())
         return 0
 
+    # dead-tunnel guard (memoized — __main__ probes before importing this
+    # module, which is what actually prevents the import-time backend hang;
+    # this call covers direct app.main() embedding)
+    from cruise_control_tpu.core.backend_probe import ensure_live_backend
+
+    ensure_live_backend()
+
     props = load_properties(args.config) if args.config else {}
     app = CruiseControlTpuApp(props)
     app.start()

@@ -104,6 +104,8 @@ class FakeClusterBackend(ClusterBackend):
         a live cluster: ``python -m cruise_control_tpu`` boots against this
         unless ``cluster.backend.class`` names a real backend.
         """
+        if num_brokers <= 0:
+            raise ValueError(f"seed_demo needs num_brokers >= 1, got {num_brokers}")
         for b in range(num_brokers):
             self.add_broker(b, rack=str(b % num_racks))
         rf = min(replication_factor, max(num_brokers, 1))

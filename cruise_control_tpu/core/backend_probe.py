@@ -1,0 +1,65 @@
+"""Dead-accelerator-tunnel guard shared by every process entry point.
+
+The tunneled TPU (experimental PJRT platform 'axon') dies under load; when it
+is dead, in-process backend init blocks ~25 minutes before erroring (observed),
+which would hang the benchmark, the app shell, and the driver entry alike.
+The probe runs ``jax.devices()`` in a KILLABLE subprocess with a timeout and
+forces the CPU platform on failure — the moral equivalent of the reference
+failing fast when it cannot reach the Kafka cluster rather than hanging its
+whole JVM (KafkaCruiseControlMain.java:26 startup path).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+#: seconds to wait for the accelerator tunnel before falling back to CPU
+#: (override with CC_TPU_PROBE_TIMEOUT_S, e.g. for fast local boots)
+BACKEND_PROBE_TIMEOUT_S = float(os.environ.get("CC_TPU_PROBE_TIMEOUT_S", 180))
+
+
+def probe_backend(timeout_s: float = BACKEND_PROBE_TIMEOUT_S) -> str:
+    """The default backend's platform ('tpu' / 'cpu' / …), 'cpu' when dead.
+
+    Probes in a subprocess so a dead tunnel can be killed at the timeout
+    instead of blocking this process for its full internal retry budget; the
+    probe prints the actual platform so a CPU-only machine is never labeled
+    'tpu' in benchmark output."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        lines = proc.stdout.strip().splitlines()
+        if proc.returncode == 0 and lines:
+            platform = lines[-1].strip().lower()
+            # the tunneled accelerator registers as the experimental 'axon'
+            # platform but is a TPU chip
+            return "tpu" if platform == "axon" else platform
+    except subprocess.TimeoutExpired:
+        pass
+    return "cpu"
+
+
+_RESOLVED: str | None = None
+
+
+def ensure_live_backend(timeout_s: float = BACKEND_PROBE_TIMEOUT_S) -> str:
+    """Probe the default backend; force the CPU platform when it's dead.
+
+    Returns the platform that will be used.  Safe to call after ``import jax``
+    (backends init lazily; forcing the config before the first device query
+    sticks even though the environment's sitecustomize pins 'axon').
+    Memoized: one probe per process — entry points may call it repeatedly."""
+    global _RESOLVED
+    if _RESOLVED is None:
+        _RESOLVED = probe_backend(timeout_s)
+        if _RESOLVED == "cpu":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+    return _RESOLVED

@@ -8,6 +8,7 @@ breaking the invariants of any prior goal.
 """
 
 import numpy as np
+import pytest
 
 from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
 from cruise_control_tpu.analyzer import goals_base as G
@@ -190,6 +191,7 @@ class TestFastMode:
 
 
 class TestSourceCapping:
+    @pytest.mark.slow
     def test_capped_rounds_reach_the_same_fixpoint(self):
         """max_active_brokers bounds per-round matrices; the while-loop still
         converges to zero hard violations, just over more rounds."""

@@ -1,0 +1,110 @@
+"""Reference-CI-scale randomized sweep (slow tier).
+
+Counterpart of ``analyzer/RandomClusterTest.java:145,157`` +
+``OptimizationVerifier.java:112``: broker-count sweep × load distribution ×
+self-healing mutation at ≥50k replicas (the reference's base scale is 40
+brokers / 50,001 replicas, swept to 20+i·60 brokers — ``TestConstants.java:89-91``).
+Each broker count keeps one array shape so the sweep shares compiled solver
+executables; the ~17k-partition RF-3 synthetics put every run at 51k replicas.
+
+Run with ``pytest -m slow``; excluded from the fast path.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
+from cruise_control_tpu.synthetic import SyntheticSpec, generate
+
+pytestmark = pytest.mark.slow
+
+BROKER_SWEEP = [100, 250, 500]
+DISTRIBUTIONS = ["uniform", "linear", "exponential"]
+NUM_PARTITIONS = 17_000          # × RF 3 = 51,000 replicas ≥ TestConstants' 50,001
+
+
+def _spec(num_brokers, dist, seed, **kw):
+    base = dict(
+        num_racks=10,
+        num_brokers=num_brokers,
+        num_topics=300,
+        num_partitions=NUM_PARTITIONS,
+        replication_factor=3,
+        distribution=dist,
+        mean_cpu=0.2,
+        mean_disk=0.2,
+        mean_nw_in=0.12,
+        mean_nw_out=0.1,
+        seed=seed,
+        skew_brokers=max(num_brokers // 4, 1),
+    )
+    base.update(kw)
+    return SyntheticSpec(**base)
+
+
+def _verify(state, final, result):
+    """OptimizationVerifier invariants: GOAL_VIOLATION, placement, rack."""
+    if result.provision.status == "RIGHT_SIZED":
+        assert not result.violated_hard_goals, result.violations_after
+    for r in result.goal_reports:
+        if r.is_hard:
+            assert r.violations_after <= r.violations_before
+    assert result.violations_after["RackAwareGoal"] == 0
+    # placement: no duplicate (partition, broker) pair — vectorized (50k rows)
+    rp = np.asarray(final.replica_partition)
+    rb = np.asarray(final.replica_broker)
+    valid = np.asarray(final.replica_valid)
+    keys = rp[valid].astype(np.int64) * final.num_brokers + rb[valid]
+    assert len(np.unique(keys)) == int(valid.sum()), "duplicate replica on a broker"
+
+
+@pytest.mark.parametrize("num_brokers", BROKER_SWEEP)
+@pytest.mark.parametrize("dist", DISTRIBUTIONS)
+def test_sweep_rebalances(num_brokers, dist):
+    state, _ = generate(_spec(num_brokers, dist, seed=31 + num_brokers))
+    ctx = GoalContext.build(state.num_topics, state.num_brokers)
+    final, result = GoalOptimizer(enable_heavy_goals=True).optimize(state, ctx)
+    _verify(state, final, result)
+
+
+@pytest.mark.parametrize("num_brokers", BROKER_SWEEP)
+def test_sweep_self_healing(num_brokers):
+    """RandomSelfHealingTest: kill ~5% of brokers, everything must drain."""
+    import jax.numpy as jnp
+
+    state, _ = generate(_spec(num_brokers, "exponential", seed=47))
+    rng = np.random.default_rng(9)
+    dead = rng.choice(num_brokers, size=max(num_brokers // 20, 1), replace=False)
+    alive = np.ones(num_brokers, bool)
+    alive[dead] = False
+    state = state.replace(broker_alive=jnp.asarray(alive))
+
+    ctx = GoalContext.build(state.num_topics, state.num_brokers)
+    final, result = GoalOptimizer(enable_heavy_goals=True).optimize(state, ctx)
+
+    rb = np.asarray(final.replica_broker)
+    valid = np.asarray(final.replica_valid)
+    on_dead = np.isin(rb[valid], dead)
+    assert not on_dead.any(), f"{on_dead.sum()} replicas left on dead brokers"
+    _verify(state, final, result)
+
+
+@pytest.mark.parametrize("num_brokers", [100])
+def test_sweep_new_brokers_get_load(num_brokers):
+    """RandomCluster*NewBrokerTest: brokers marked new receive replicas."""
+    import jax.numpy as jnp
+
+    state, _ = generate(
+        _spec(num_brokers, "exponential", seed=13,
+              skew_brokers=num_brokers - 10)
+    )
+    new = np.zeros(num_brokers, bool)
+    new[-10:] = True
+    state = state.replace(broker_new=jnp.asarray(new))
+    ctx = GoalContext.build(state.num_topics, state.num_brokers)
+    final, result = GoalOptimizer(enable_heavy_goals=True).optimize(state, ctx)
+    rb = np.asarray(final.replica_broker)
+    valid = np.asarray(final.replica_valid)
+    counts = np.bincount(rb[valid], minlength=num_brokers)
+    assert (counts[-10:] > 0).all(), "new brokers received no replicas"
+    _verify(state, final, result)
