@@ -1,0 +1,154 @@
+"""OpenAPI 3 document generated from the live endpoint registry + schemas.
+
+The reference ships a hand-maintained OpenAPI YAML
+(``src/main/resources/yaml/base.yaml`` + per-endpoint files) that its servlet
+tests schema-check responses against.  Here the spec is *derived* from the
+same registries the server actually dispatches on (``server.GET_ENDPOINTS`` /
+``POST_ENDPOINTS``) and validates with (``schemas.RESPONSE_SCHEMAS``), so the
+published contract cannot drift from the implementation.
+
+``python -m cruise_control_tpu.api.openapi [out.yaml]`` writes the document;
+the committed copy lives at ``docs/openapi.yaml``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from cruise_control_tpu.api.schemas import RESPONSE_SCHEMAS
+from cruise_control_tpu.api.server import (
+    API_PREFIX,
+    GET_ENDPOINTS,
+    POST_ENDPOINTS,
+    REVIEWABLE,
+)
+
+#: common query parameters (CruiseControlParameters subclasses)
+_COMMON_PARAMS = [
+    {"name": "json", "in": "query", "required": False,
+     "schema": {"type": "boolean"},
+     "description": "JSON response (always true here; kept for CLI parity)"},
+]
+_ASYNC_PARAMS = [
+    {"name": "dryrun", "in": "query", "required": False,
+     "schema": {"type": "boolean"},
+     "description": "compute proposals without executing them"},
+    {"name": "goals", "in": "query", "required": False,
+     "schema": {"type": "string"},
+     "description": "comma-separated goal names overriding the default list"},
+    {"name": "review_id", "in": "query", "required": False,
+     "schema": {"type": "integer"},
+     "description": "approved two-step-verification request to execute"},
+]
+
+
+def _schema_to_openapi(schema: Any) -> Dict[str, Any]:
+    """Translate the schemas.py mini-language into an OpenAPI schema object."""
+    if schema is None:
+        return {"nullable": True}
+    if isinstance(schema, tuple):
+        alts = [_schema_to_openapi(s) for s in schema]
+        nullable = any(a == {"nullable": True} for a in alts)
+        alts = [a for a in alts if a != {"nullable": True}]
+        if len(alts) == 1:
+            out = dict(alts[0])
+        else:
+            out = {"oneOf": alts}
+        if nullable:
+            out["nullable"] = True
+        return out
+    if isinstance(schema, type):
+        return {
+            bool: {"type": "boolean"},
+            int: {"type": "integer"},
+            float: {"type": "number"},
+            str: {"type": "string"},
+            dict: {"type": "object"},
+            list: {"type": "array", "items": {}},
+        }.get(schema, {"type": "object"})
+    if isinstance(schema, dict):
+        props = {}
+        required = []
+        for key, sub in schema.items():
+            optional = key.startswith("?")
+            name = key[1:] if optional else key
+            props[name] = _schema_to_openapi(sub)
+            if not optional:
+                required.append(name)
+        out: Dict[str, Any] = {"type": "object", "properties": props}
+        if required:
+            out["required"] = sorted(required)
+        return out
+    if isinstance(schema, list):
+        return {"type": "array", "items": _schema_to_openapi(schema[0])}
+    return {"type": "object"}
+
+
+def generate_openapi() -> Dict[str, Any]:
+    """The OpenAPI 3.0 document for the live REST surface."""
+    paths: Dict[str, Any] = {}
+    for name in sorted(GET_ENDPOINTS | POST_ENDPOINTS):
+        method = "get" if name in GET_ENDPOINTS else "post"
+        body_schema = RESPONSE_SCHEMAS.get(name)
+        responses: Dict[str, Any] = {
+            "200": {
+                "description": "success",
+                "content": {
+                    "application/json": {
+                        "schema": _schema_to_openapi(body_schema)
+                        if body_schema is not None
+                        else {"type": "object"}
+                    }
+                },
+            }
+        }
+        params = list(_COMMON_PARAMS)
+        if method == "post":
+            responses["202"] = {
+                "description": (
+                    "accepted — async operation in progress; poll with the "
+                    "returned User-Task-ID header/userTaskId field"
+                ),
+                "content": {"application/json": {"schema": {"type": "object"}}},
+            }
+            params = params + _ASYNC_PARAMS
+            if name in REVIEWABLE:
+                responses["202"]["description"] += (
+                    "; may instead return a pending review entry when "
+                    "two-step verification is enabled"
+                )
+        op = {
+            "operationId": name.lower(),
+            "summary": name,
+            "parameters": params,
+            "responses": responses,
+        }
+        paths[API_PREFIX + name.lower()] = {method: op}
+
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "cruise-control-tpu REST API",
+            "description": (
+                "TPU-native Cruise Control: the reference's 22-endpoint "
+                "surface (servlet/CruiseControlEndPoint.java:16-39) plus "
+                "identical async 202/User-Task-ID semantics "
+                "(servlet/UserTaskManager.java:222)."
+            ),
+            "version": "0.4.0",
+        },
+        "paths": paths,
+    }
+
+
+def write_yaml(path: str) -> None:
+    import yaml
+
+    with open(path, "w") as f:
+        yaml.safe_dump(generate_openapi(), f, sort_keys=False)
+
+
+if __name__ == "__main__":
+    import sys
+
+    write_yaml(sys.argv[1] if len(sys.argv) > 1 else "docs/openapi.yaml")

@@ -45,6 +45,10 @@ def required_role(endpoint: str, method: str) -> Role:
 class SecurityProvider:
     """Resolve a request's (user, role); None user means anonymous."""
 
+    #: optional (header, value) the server sends with a 401 so conforming
+    #: clients know which scheme to retry with (WWW-Authenticate)
+    challenge_header: Optional[Tuple[str, str]] = None
+
     def authenticate(self, headers: Mapping[str, str]) -> Tuple[Optional[str], Role]:
         raise NotImplementedError
 
@@ -62,6 +66,8 @@ class AuthenticationError(Exception):
 
 
 class BasicSecurityProvider(SecurityProvider):
+    challenge_header = ("WWW-Authenticate", 'Basic realm="cruise-control-tpu"')
+
     def __init__(self, users: Dict[str, Tuple[str, Role]]) -> None:
         """``users``: name -> (password, role)."""
         self.users = users

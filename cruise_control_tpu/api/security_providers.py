@@ -1,10 +1,9 @@
-"""Additional security providers: JWT bearer tokens and trusted proxies.
+"""Additional security providers: JWT bearer tokens, trusted proxies, SPNEGO.
 
 Counterparts of the reference's pluggable security stacks
-(``servlet/security/jwt/`` — JwtLoginService/JwtAuthenticator — and
-``servlet/security/trustedproxy/`` — TrustedProxyLoginService); SPNEGO/Kerberos
-is out of scope for a stdlib-only build (its role — verified identity from an
-external authority — is covered by the JWT provider).
+(``servlet/security/jwt/`` — JwtLoginService/JwtAuthenticator,
+``servlet/security/trustedproxy/`` — TrustedProxyLoginService, and
+``servlet/security/spnego/`` — SpnegoSecurityProvider).
 
 * :class:`JwtSecurityProvider` verifies ``Authorization: Bearer <jwt>`` tokens
   signed with HS256 (stdlib hmac), checks ``exp`` and optional ``aud``, and maps
@@ -12,6 +11,11 @@ external authority — is covered by the JWT provider).
 * :class:`TrustedProxySecurityProvider` authenticates a fronting proxy by a
   shared secret header, then trusts the end-user identity the proxy forwards
   (``doAs`` semantics), with a per-user role table.
+* :class:`SpnegoSecurityProvider` implements the HTTP ``Negotiate`` flow; the
+  GSSAPI token validation itself is delegated to python-gssapi when installed
+  (Kerberos is an OS/keytab integration, not something to hand-roll), with the
+  same principal→role mapping the reference applies
+  (``DefaultRoleSecurityProvider`` semantics, principal shortnames).
 """
 
 from __future__ import annotations
@@ -46,6 +50,19 @@ def encode_jwt(claims: Mapping[str, object], secret: str) -> str:
 
 class JwtSecurityProvider(SecurityProvider):
     """``Authorization: Bearer`` HS256 validation (servlet/security/jwt/)."""
+
+    challenge_header = ("WWW-Authenticate", "Bearer")
+
+    @classmethod
+    def from_config(cls, cfg) -> "JwtSecurityProvider":
+        secret = cfg.get("webserver.security.jwt.secret")
+        if not secret:
+            from cruise_control_tpu.core.config import ConfigException
+
+            raise ConfigException(
+                "JwtSecurityProvider requires webserver.security.jwt.secret"
+            )
+        return cls(secret)
 
     def __init__(
         self,
@@ -103,6 +120,18 @@ class TrustedProxySecurityProvider(SecurityProvider):
     """Authenticate the proxy, trust its forwarded end-user identity
     (servlet/security/trustedproxy/ semantics with a shared-secret handshake)."""
 
+    @classmethod
+    def from_config(cls, cfg) -> "TrustedProxySecurityProvider":
+        secret = cfg.get("webserver.security.trusted.proxy.secret")
+        if not secret:
+            from cruise_control_tpu.core.config import ConfigException
+
+            raise ConfigException(
+                "TrustedProxySecurityProvider requires "
+                "webserver.security.trusted.proxy.secret"
+            )
+        return cls(secret)
+
     def __init__(
         self,
         proxy_secret: str,
@@ -124,4 +153,88 @@ class TrustedProxySecurityProvider(SecurityProvider):
         user = headers.get(self.user_header)
         if not user:
             raise AuthenticationError("proxy forwarded no user")
+        return user, self.user_roles.get(user, self.default_role)
+
+
+class SpnegoSecurityProvider(SecurityProvider):
+    """HTTP Negotiate (SPNEGO/Kerberos) authentication.
+
+    Counterpart of ``servlet/security/spnego/SpnegoSecurityProvider.java``:
+    the client sends ``Authorization: Negotiate <base64 GSS token>``; the
+    service accepts it against its keytab credential and derives the user from
+    the initiator principal's shortname (``user@REALM`` / ``user/host@REALM``
+    → ``user``), which maps onto ADMIN/USER/VIEWER like every other provider.
+
+    Token acceptance is delegated to python-gssapi (an MIT/Heimdal binding —
+    Kerberos is OS integration, not something to reimplement).  When gssapi is
+    not installed the provider still speaks the protocol (401 +
+    ``WWW-Authenticate: Negotiate`` challenge) but rejects all tokens, so a
+    misconfigured deployment fails closed, never open.
+    """
+
+    challenge_header = ("WWW-Authenticate", "Negotiate")
+
+    def __init__(
+        self,
+        service_principal: Optional[str] = None,
+        user_roles: Optional[Dict[str, Role]] = None,
+        default_role: Role = Role.USER,
+    ) -> None:
+        self.service_principal = service_principal
+        self.user_roles = user_roles or {}
+        self.default_role = default_role
+        try:
+            import gssapi  # type: ignore
+
+            self._gssapi = gssapi
+        except ImportError:
+            self._gssapi = None
+
+    @staticmethod
+    def principal_shortname(principal: str) -> str:
+        """``user/host@REALM`` → ``user`` (the reference's PrincipalName
+        shortname rule used for role lookup)."""
+        return principal.split("@", 1)[0].split("/", 1)[0]
+
+    @classmethod
+    def from_config(cls, cfg) -> "SpnegoSecurityProvider":
+        return cls(service_principal=cfg.get("webserver.security.spnego.principal") or None)
+
+    def _accept_token(self, token: bytes) -> str:
+        """Validate the GSS token, returning the initiator principal."""
+        if self._gssapi is None:
+            raise AuthenticationError(
+                "SPNEGO configured but python-gssapi is not installed"
+            )
+        gssapi = self._gssapi
+        # every GSS failure (garbage token, missing/expired keytab, clock
+        # skew) must surface as a 401, never a crashed request handler
+        try:
+            creds = None
+            if self.service_principal:
+                name = gssapi.Name(
+                    self.service_principal,
+                    name_type=gssapi.NameType.kerberos_principal,
+                )
+                creds = gssapi.Credentials(name=name, usage="accept")
+            ctx = gssapi.SecurityContext(creds=creds, usage="accept")
+            ctx.step(token)
+            if not ctx.complete:
+                raise AuthenticationError("SPNEGO negotiation incomplete")
+            return str(ctx.initiator_name)
+        except AuthenticationError:
+            raise
+        except Exception as e:
+            raise AuthenticationError(f"SPNEGO rejected: {e}") from e
+
+    def authenticate(self, headers: Mapping[str, str]) -> Tuple[Optional[str], Role]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Negotiate "):
+            raise AuthenticationError("missing Negotiate token")
+        try:
+            token = base64.b64decode(auth[len("Negotiate "):].strip())
+        except Exception:
+            raise AuthenticationError("malformed Negotiate token") from None
+        principal = self._accept_token(token)
+        user = self.principal_shortname(principal)
         return user, self.user_roles.get(user, self.default_role)

@@ -468,7 +468,8 @@ class CruiseControlApp:
         try:
             user, role = self.security.authenticate(headers)
         except AuthenticationError as e:
-            return 401, {"error": str(e)}, {}
+            challenge = getattr(self.security, "challenge_header", None)
+            return 401, {"error": str(e)}, dict([challenge] if challenge else [])
         if not self.security.authorize(role, endpoint, method):
             return 403, {"error": f"role {role.name} may not {method} {endpoint}"}, {}
 

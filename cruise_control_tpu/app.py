@@ -93,6 +93,13 @@ def _security(cfg: Config) -> Optional[SecurityProvider]:
         return None
     from cruise_control_tpu.api.security import Role
 
+    provider_spec = cfg.get("webserver.security.provider.class")
+    if provider_spec:
+        cls = resolve_class(provider_spec)
+        if hasattr(cls, "from_config"):
+            return cls.from_config(cfg)
+        return cls()
+
     path = cfg.get("webserver.auth.credentials.file")
     users = {}
     if path:

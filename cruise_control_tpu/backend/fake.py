@@ -108,7 +108,7 @@ class FakeClusterBackend(ClusterBackend):
             raise ValueError(f"seed_demo needs num_brokers >= 1, got {num_brokers}")
         for b in range(num_brokers):
             self.add_broker(b, rack=str(b % num_racks))
-        rf = min(replication_factor, max(num_brokers, 1))
+        rf = min(replication_factor, num_brokers)
         for p in range(num_partitions):
             topic = f"demo-{p % max(num_topics, 1)}"
             # skew leaders onto the first half of the brokers

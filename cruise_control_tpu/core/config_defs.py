@@ -210,6 +210,16 @@ def webserver_config() -> ConfigDef:
              "Enable HTTP authentication.")
     d.define("webserver.auth.credentials.file", Type.STRING, "", M,
              "Credentials file: 'user: password, ROLE' per line (Jetty realm format).")
+    d.define("webserver.security.provider.class", Type.STRING, "", M,
+             "SecurityProvider implementation (dotted module.Class path). Empty = HTTP Basic from "
+             "the credentials file; api.security_providers ships JWT, trusted-proxy "
+             "and SPNEGO providers (servlet/security/ counterparts).")
+    d.define("webserver.security.jwt.secret", Type.STRING, "", M,
+             "HS256 secret for JwtSecurityProvider.")
+    d.define("webserver.security.trusted.proxy.secret", Type.STRING, "", M,
+             "Shared secret for TrustedProxySecurityProvider's proxy handshake.")
+    d.define("webserver.security.spnego.principal", Type.STRING, "", M,
+             "Service principal for SpnegoSecurityProvider (empty = default keytab credential).")
     d.define("two.step.verification.enabled", Type.BOOLEAN, False, M,
              "Park POSTs in the purgatory until reviewed.")
     d.define("two.step.purgatory.retention.time.ms", Type.LONG, 1_209_600_000, L,

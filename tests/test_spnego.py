@@ -1,0 +1,97 @@
+"""SPNEGO provider protocol tests (no KDC in CI — the GSS step is faked)."""
+
+import base64
+
+import pytest
+
+from cruise_control_tpu.api.security import AuthenticationError, Role
+from cruise_control_tpu.api.security_providers import SpnegoSecurityProvider
+
+
+def test_principal_shortname_rule():
+    f = SpnegoSecurityProvider.principal_shortname
+    assert f("alice@EXAMPLE.COM") == "alice"
+    assert f("svc/host01.example.com@EXAMPLE.COM") == "svc"
+    assert f("bob") == "bob"
+
+
+def test_missing_negotiate_header_rejected():
+    p = SpnegoSecurityProvider()
+    with pytest.raises(AuthenticationError):
+        p.authenticate({})
+    with pytest.raises(AuthenticationError):
+        p.authenticate({"Authorization": "Bearer nope"})
+
+
+def test_fails_closed_without_gssapi():
+    p = SpnegoSecurityProvider()
+    p._gssapi = None  # CI has no kerberos binding; must reject, never accept
+    tok = base64.b64encode(b"\x60\x82fake").decode()
+    with pytest.raises(AuthenticationError):
+        p.authenticate({"Authorization": f"Negotiate {tok}"})
+
+
+def test_accepted_token_maps_principal_to_role():
+    p = SpnegoSecurityProvider(user_roles={"alice": Role.ADMIN})
+    p._accept_token = lambda token: "alice@EXAMPLE.COM"
+    tok = base64.b64encode(b"\x60\x82ok").decode()
+    user, role = p.authenticate({"Authorization": f"Negotiate {tok}"})
+    assert (user, role) == ("alice", Role.ADMIN)
+
+    p2 = SpnegoSecurityProvider()
+    p2._accept_token = lambda token: "bob@EXAMPLE.COM"
+    user2, role2 = p2.authenticate({"Authorization": f"Negotiate {tok}"})
+    assert (user2, role2) == ("bob", Role.USER)
+
+
+def test_malformed_base64_rejected():
+    p = SpnegoSecurityProvider()
+    p._accept_token = lambda token: "x"
+    with pytest.raises(AuthenticationError):
+        p.authenticate({"Authorization": "Negotiate $$$not-base64$$$"})
+
+
+def test_provider_class_config_wiring():
+    """webserver.security.provider.class resolves and constructs each shipped
+    provider; missing required secrets fail with a ConfigException, not a
+    TypeError crash."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cruise_control_tpu.app import _security, cruise_control_config
+    from cruise_control_tpu.core.config import Config, ConfigException
+
+    mod = "cruise_control_tpu.api.security_providers"
+
+    def cfg(**props):
+        base = {"webserver.security.enable": "true"}
+        base.update(props)
+        return Config(cruise_control_config(), base)
+
+    p = _security(cfg(**{
+        "webserver.security.provider.class": f"{mod}.SpnegoSecurityProvider"}))
+    assert type(p).__name__ == "SpnegoSecurityProvider"
+
+    p = _security(cfg(**{
+        "webserver.security.provider.class": f"{mod}.JwtSecurityProvider",
+        "webserver.security.jwt.secret": "s3cret"}))
+    assert type(p).__name__ == "JwtSecurityProvider"
+    with pytest.raises(ConfigException):
+        _security(cfg(**{
+            "webserver.security.provider.class": f"{mod}.JwtSecurityProvider"}))
+
+    p = _security(cfg(**{
+        "webserver.security.provider.class": f"{mod}.TrustedProxySecurityProvider",
+        "webserver.security.trusted.proxy.secret": "pxy"}))
+    assert type(p).__name__ == "TrustedProxySecurityProvider"
+
+
+def test_401_carries_challenge_header():
+    """The server's 401 must emit the provider's WWW-Authenticate challenge —
+    Negotiate clients only send a token after being challenged."""
+    from tests.test_api import build_app
+
+    app = build_app(security=SpnegoSecurityProvider())
+    status, body, headers = app.handle("GET", "STATE", {}, {})
+    assert status == 401
+    assert headers.get("WWW-Authenticate") == "Negotiate"
