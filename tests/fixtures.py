@@ -115,3 +115,20 @@ def rack_aware_unsatisfiable() -> ClusterModel:
     cluster.create_replica(2, (T1, 0), 2, False)
     cluster.set_replica_load(2, (T1, 0), load(5.0, 100.0, 0.0, 75.0))
     return cluster
+
+
+#: trimmed goal list for service-layer tests (api/detector/provision/aux):
+#: their subject is the surrounding plumbing, not goal math — compiling the
+#: full 16-goal pipeline per module costs ~4 min on the 1-core CI box, and
+#: the goal kernels have their own dedicated test modules.
+def service_test_goals():
+    from cruise_control_tpu.analyzer import goals_base as G
+
+    return (
+        G.RACK_AWARE,
+        G.REPLICA_CAPACITY,
+        G.DISK_CAPACITY,
+        G.CPU_CAPACITY,
+        G.REPLICA_DISTRIBUTION,
+        G.DISK_USAGE_DIST,
+    )

@@ -55,7 +55,12 @@ def build_app(num_brokers=4, partitions=12, **app_kw) -> CruiseControlApp:
         pause_sampling=monitor.pause_sampling,
         resume_sampling=monitor.resume_sampling,
     )
-    cc = CruiseControl(backend, monitor, executor)
+    from tests.fixtures import service_test_goals
+
+    cc = CruiseControl(
+        backend, monitor, executor,
+        goal_ids=service_test_goals(), enable_heavy_goals=False,
+    )
     cc.start()
     for w in range(6):
         monitor.sample_once(now_ms=(w + 1) * WINDOW_MS)

@@ -43,6 +43,12 @@ def served_app():
             "cruise_control_tpu.monitor.samplestore.NoopSampleStore",
         "webserver.http.port": 0,                   # ephemeral
         "min.valid.partition.ratio": 0.5,
+        # trimmed goal list: this module tests the app shell + HTTP client,
+        # not goal math — the full 16-goal compile costs ~4 min on 1-core CI
+        "default.goals": (
+            "RackAwareGoal,ReplicaCapacityGoal,DiskCapacityGoal,"
+            "CpuCapacityGoal,ReplicaDistributionGoal,DiskUsageDistributionGoal"
+        ),
     }
     app = CruiseControlTpuApp(props, backend=seeded_backend())
     # the static capacity resolver default is 1.0 per resource; give real numbers

@@ -9,6 +9,10 @@ optimize — the host↔device round-trip count that dominates on a tunneled TPU
 """
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow   # full-pipeline compiles; movement accounting
+# is also exercised by every bench run (bench.py prints the movement fields)
 
 from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
 from cruise_control_tpu.analyzer.optimizer import movement_stats

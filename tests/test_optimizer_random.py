@@ -15,6 +15,9 @@ than exact outcomes:
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow   # superseded in the fast tier by the unit goal
+# modules; the reference-CI-scale sweep lives in test_random_scale.py (slow)
+
 from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
 from cruise_control_tpu.analyzer import goals_base as G
 from cruise_control_tpu.core.resources import Resource

@@ -169,6 +169,7 @@ class TestKafkaAssignerMode:
 
 
 class TestFastMode:
+    @pytest.mark.slow
     def test_fast_mode_caps_rounds(self):
         """OptimizationOptions.fastMode: bounded wall-clock — every phase stops
         within FAST_MODE_MAX_ROUNDS rounds (fast.mode.per.broker.move.timeout.ms

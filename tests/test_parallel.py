@@ -88,6 +88,7 @@ class TestShardedPrimitives:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
 
 
+@pytest.mark.slow
 class TestShardedSolver:
     def _cluster(self):
         spec = SyntheticSpec(

@@ -48,7 +48,12 @@ def build_cc(num_brokers=4, partitions=12):
         num_windows=4, window_ms=WINDOW_MS,
     )
     executor = Executor(backend)
-    cc = CruiseControl(backend, monitor, executor)
+    from tests.fixtures import service_test_goals
+
+    cc = CruiseControl(
+        backend, monitor, executor,
+        goal_ids=service_test_goals(), enable_heavy_goals=False,
+    )
     cc.start()
     for w in range(6):
         monitor.sample_once(now_ms=(w + 1) * WINDOW_MS)
