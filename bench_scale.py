@@ -133,8 +133,20 @@ def main() -> None:
         t0 = time.monotonic()
         opt.optimize(state, ctx)
         compile_s = time.monotonic() - t0
+    def _progress(name, rounds, moves, after, dur):
+        import sys
+
+        print(
+            f"# goal {name}: rounds={rounds} moves={moves} "
+            f"violations_after={after:.0f} {dur:.1f}s",
+            file=sys.stderr, flush=True,
+        )
+
     t0 = time.monotonic()
-    final, result = opt.optimize(state, ctx, profile_goals=args.profile)
+    final, result = opt.optimize(
+        state, ctx, profile_goals=args.profile,
+        on_goal_done=_progress if args.profile else None,
+    )
     wall = time.monotonic() - t0
 
     residual_hard = sum(

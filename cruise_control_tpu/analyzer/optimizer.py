@@ -423,6 +423,7 @@ class GoalOptimizer:
         maps=None,
         raise_on_hard_failure: bool = False,
         profile_goals: bool = False,
+        on_goal_done=None,
     ) -> Tuple[ClusterArrays, OptimizerResult]:
         """Run the goal list; one async device dispatch per goal.
 
@@ -436,6 +437,10 @@ class GoalOptimizer:
         the cost of one round-trip per goal; otherwise per-goal durations
         measure enqueue time only and the total ``duration_s`` is authoritative.
         ``raise_on_hard_failure`` implies per-goal blocking for hard goals.
+        ``on_goal_done(name, rounds, moves, violations_after, duration_s)`` is
+        called after each goal when profiling — long runs (hours at config-#4
+        scale on a CPU host) need observable progress, the way the reference
+        streams per-goal OptimizationForGoal progress steps.
         """
         from cruise_control_tpu.core.sensors import PROPOSAL_COMPUTATION_TIMER, REGISTRY
 
@@ -503,7 +508,13 @@ class GoalOptimizer:
                     f"{G.GOAL_NAMES[gid]} unsatisfied: "
                     f"{float(viol_cur[gid]):.0f} violations remain"
                 )
-            raw.append((gid, viol_prev, viol_cur, rounds, moves, time.monotonic() - g0))
+            dur = time.monotonic() - g0
+            raw.append((gid, viol_prev, viol_cur, rounds, moves, dur))
+            if profile_goals and on_goal_done is not None:
+                on_goal_done(
+                    G.GOAL_NAMES[gid], int(rounds), int(moves),
+                    float(viol_cur[gid]), dur,
+                )
             prior = prior + (gid,)
 
         # single bulk host fetch of every per-goal scalar
