@@ -41,7 +41,7 @@ def main() -> None:
     from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
     from cruise_control_tpu.analyzer import goals_base as G
     from cruise_control_tpu.analyzer.goal_rounds import GOAL_ROUNDS
-    from cruise_control_tpu.analyzer.optimizer import _goal_step, _mask_of
+    from cruise_control_tpu.analyzer.optimizer import _goal_step
     from cruise_control_tpu.parallel import ShardedGoalOptimizer, solver_mesh
     from cruise_control_tpu.parallel.mesh import replicate, shard_state
     from cruise_control_tpu.synthetic import SyntheticSpec, generate
@@ -67,9 +67,11 @@ def main() -> None:
     sstate = shard_state(state, mesh)
     sctx = replicate(ctx, mesh)
     lowered = _goal_step.lower(
-        sstate, sctx, _mask_of(()), _mask_of((G.RACK_AWARE,)),
+        sstate, sctx,
+        gid=G.RACK_AWARE,
         round_fns=GOAL_ROUNDS[G.RACK_AWARE],
         max_rounds=2000, enable_heavy=False,
+        prior_ids=(), admit_ids=(G.RACK_AWARE,),
     )
     t0 = time.monotonic()
     compiled = lowered.compile()

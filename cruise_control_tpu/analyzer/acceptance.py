@@ -25,10 +25,12 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from cruise_control_tpu.analyzer import goals_base as G
 from cruise_control_tpu.analyzer.context import GoalContext, Snapshot
 from cruise_control_tpu.analyzer.moves import (
+    KIND_INTRA_MOVE,
     KIND_LEADERSHIP,
     KIND_REPLICA_MOVE,
     KIND_SWAP,
@@ -37,6 +39,15 @@ from cruise_control_tpu.analyzer.moves import (
 )
 from cruise_control_tpu.core.resources import Resource
 from cruise_control_tpu.model.arrays import ClusterArrays
+
+
+def _off(mask, *gids) -> bool:
+    """True when a CONCRETE (numpy) goal mask disables every goal in ``gids`` —
+    tracing then skips the kernel outright, so a phase compiled for a static
+    prior-goal set (optimizer._phase's ``prior_ids``) carries only the
+    acceptance terms it can actually need.  Traced masks never skip: the
+    ``jnp.where`` select stays and one compiled step serves every position."""
+    return isinstance(mask, np.ndarray) and not any(bool(mask[g]) for g in gids)
 
 
 def _rack_ok_one_direction(state, snap, partition, src_broker, dst_broker):
@@ -327,6 +338,54 @@ def accept_intra_disk_dist(state, ctx, snap, moves, eff):
     return ok | ~eff.valid
 
 
+def _assigner_even_state(state):
+    """(per-position counts i32[PC, B], clipped positions i32[R]) shared by the
+    even-placement acceptance terms — the single source of the "gaining broker
+    stays strictly below the losing one at each position" invariant's inputs."""
+    from cruise_control_tpu.analyzer.goals_base import (
+        ASSIGNER_POS_CAP,
+        assigner_position_counts,
+    )
+    from cruise_control_tpu.analyzer.kafka_assigner import replica_positions
+
+    pc = assigner_position_counts(state)
+    pos = jnp.clip(replica_positions(state), 0, ASSIGNER_POS_CAP - 1)
+    return pc, pos
+
+
+def accept_assigner_even(state, ctx, snap, moves, eff):
+    """KafkaAssignerEvenRackAwareGoal as a PRIOR goal: rack validity (the base
+    kernel) plus even-placement preservation — a later goal's action may not
+    skew any position's replica counts past the max−min ≤ 1 the constructive
+    placement established (KafkaAssignerEvenRackAwareGoal.java:496-504).
+
+    A replica move lands at the destination only if it stays strictly below
+    the source's count at that position; leadership transfers and swaps
+    exchange two positions between the endpoint brokers and must satisfy the
+    condition in both directions (same-position exchanges and intra-broker
+    logdir moves change no count).
+    """
+    rack_ok = accept_rack_aware(state, ctx, snap, moves, eff)
+    counts, pos = _assigner_even_state(state)
+    r = jnp.where(moves.replica >= 0, moves.replica, 0)
+    rb = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+    q_out = pos[r]
+    q_in = pos[rb]
+    src, dst = eff.src_broker, eff.dst_broker
+    move_ok = counts[q_out, dst] + 1 <= counts[q_out, src]
+    pair_ok = (
+        (counts[q_out, dst] + 1 <= counts[q_out, src])
+        & (counts[q_in, src] + 1 <= counts[q_in, dst])
+    ) | (q_out == q_in) | (src == dst)
+    kind = moves.kind
+    even_ok = jnp.where(
+        kind == KIND_REPLICA_MOVE,
+        move_ok,
+        jnp.where(kind == KIND_INTRA_MOVE, True, pair_ok),
+    )
+    return rack_ok & even_ok
+
+
 _KERNELS = {
     G.RACK_AWARE: accept_rack_aware,
     G.MIN_TOPIC_LEADERS: accept_min_topic_leaders,
@@ -342,7 +401,7 @@ _KERNELS = {
     G.RACK_AWARE_DISTRIBUTION: accept_rack_aware_dist,
     G.TOPIC_LEADER_DIST: accept_topic_leader_dist,
     G.BROKER_SET_AWARE: accept_broker_set_aware,
-    G.KAFKA_ASSIGNER_RACK: accept_rack_aware,
+    G.KAFKA_ASSIGNER_RACK: accept_assigner_even,
 }
 
 
@@ -361,21 +420,28 @@ def accept_all(
     """
     ok = eff.valid
     for gid, fn in _KERNELS.items():
+        if _off(prior_mask, gid):
+            continue
         ok = ok & jnp.where(prior_mask[gid], fn(state, ctx, snap, moves, eff), True)
     for gid, res in G.CAPACITY_RESOURCE.items():
+        if _off(prior_mask, gid):
+            continue
         ok = ok & jnp.where(
             prior_mask[gid], accept_capacity(state, ctx, snap, moves, eff, res), True
         )
     for gid, res in G.DIST_RESOURCE.items():
+        if _off(prior_mask, gid):
+            continue
         ok = ok & jnp.where(
             prior_mask[gid], accept_resource_dist(state, ctx, snap, moves, eff, res), True
         )
     # kafka-assigner disk goal shares ResourceDistributionGoal's DISK acceptance
-    ok = ok & jnp.where(
-        prior_mask[G.KAFKA_ASSIGNER_DISK],
-        accept_resource_dist(state, ctx, snap, moves, eff, Resource.DISK),
-        True,
-    )
+    if not _off(prior_mask, G.KAFKA_ASSIGNER_DISK):
+        ok = ok & jnp.where(
+            prior_mask[G.KAFKA_ASSIGNER_DISK],
+            accept_resource_dist(state, ctx, snap, moves, eff, Resource.DISK),
+            True,
+        )
     return ok
 
 
@@ -424,29 +490,45 @@ def move_dst_matrix(
     ok = jnp.ones((S, ncols), bool)
 
     # RackAwareGoal (and the kafka-assigner strict variant)
-    dst_rack = gb(state.broker_rack)[None, :]    # [1, cols]
-    src_rack = state.broker_rack[src][:, None]  # [S, 1]
-    occ = snap.rack_counts[p][:, gb(state.broker_rack)] - (src_rack == dst_rack).astype(jnp.int32)
-    strict_rack = prior_mask[G.RACK_AWARE] | prior_mask[G.KAFKA_ASSIGNER_RACK]
-    ok &= jnp.where(strict_rack, occ == 0, True)
+    if not _off(prior_mask, G.RACK_AWARE, G.KAFKA_ASSIGNER_RACK):
+        dst_rack = gb(state.broker_rack)[None, :]    # [1, cols]
+        src_rack = state.broker_rack[src][:, None]  # [S, 1]
+        occ = snap.rack_counts[p][:, gb(state.broker_rack)] - (src_rack == dst_rack).astype(jnp.int32)
+        strict_rack = prior_mask[G.RACK_AWARE] | prior_mask[G.KAFKA_ASSIGNER_RACK]
+        ok &= jnp.where(strict_rack, occ == 0, True)
+
+    # KafkaAssignerEvenRackAwareGoal's even-placement half: the destination
+    # must stay strictly below the source's per-position count (see
+    # accept_assigner_even)
+    if not _off(prior_mask, G.KAFKA_ASSIGNER_RACK):
+        pc, pos_all = _assigner_even_state(state)
+        q = pos_all[r]
+        c_dst = pc[q][:, (db if db is not None else jnp.arange(B))]  # [S, cols]
+        c_src = pc[q, src][:, None]
+        ok &= jnp.where(prior_mask[G.KAFKA_ASSIGNER_RACK], c_dst + 1 <= c_src, True)
 
     # RackAwareDistributionGoal (relaxed): dst rack stays within its fair share
-    from cruise_control_tpu.analyzer.context import rack_fair_share
+    if not _off(prior_mask, G.RACK_AWARE_DISTRIBUTION):
+        from cruise_control_tpu.analyzer.context import rack_fair_share
 
-    fair = rack_fair_share(state, snap, p)[:, None]
-    occ_src = snap.rack_counts[p][jnp.arange(S), state.broker_rack[src]][:, None]
-    rad_ok = (occ + 1 <= fair) | (occ + 1 <= occ_src - 1)
-    ok &= jnp.where(prior_mask[G.RACK_AWARE_DISTRIBUTION], rad_ok, True)
+        dst_rack = gb(state.broker_rack)[None, :]
+        src_rack = state.broker_rack[src][:, None]
+        occ = snap.rack_counts[p][:, gb(state.broker_rack)] - (src_rack == dst_rack).astype(jnp.int32)
+        fair = rack_fair_share(state, snap, p)[:, None]
+        occ_src = snap.rack_counts[p][jnp.arange(S), state.broker_rack[src]][:, None]
+        rad_ok = (occ + 1 <= fair) | (occ + 1 <= occ_src - 1)
+        ok &= jnp.where(prior_mask[G.RACK_AWARE_DISTRIBUTION], rad_ok, True)
 
     # BrokerSetAwareGoal: destination stays inside the topic's broker set
-    want = ctx.broker_set_of_topic[topic][:, None]
-    have = gb(ctx.broker_set_of_broker)[None, :]
-    ok &= jnp.where(
-        prior_mask[G.BROKER_SET_AWARE], (want < 0) | (have == want), True
-    )
+    if not _off(prior_mask, G.BROKER_SET_AWARE):
+        want = ctx.broker_set_of_topic[topic][:, None]
+        have = gb(ctx.broker_set_of_broker)[None, :]
+        ok &= jnp.where(
+            prior_mask[G.BROKER_SET_AWARE], (want < 0) | (have == want), True
+        )
 
     # MinTopicLeadersPerBrokerGoal — source-side only (leader leaving a broker)
-    if snap.enable_heavy:
+    if snap.enable_heavy and not _off(prior_mask, G.MIN_TOPIC_LEADERS):
         protected = ctx.min_leader_topics[topic]
         after_src = snap.topic_leader_counts[src, topic] - leads.astype(jnp.int32)
         mtl_ok = ~(protected & leads) | (after_src >= ctx.constraint.min_topic_leaders_per_broker)
@@ -454,34 +536,41 @@ def move_dst_matrix(
 
     # ReplicaCapacityGoal
     counts = snap.replica_counts
-    ok &= jnp.where(
-        prior_mask[G.REPLICA_CAPACITY],
-        (gb(counts)[None, :] + 1 <= ctx.constraint.max_replicas_per_broker),
-        True,
-    )
+    if not _off(prior_mask, G.REPLICA_CAPACITY):
+        ok &= jnp.where(
+            prior_mask[G.REPLICA_CAPACITY],
+            (gb(counts)[None, :] + 1 <= ctx.constraint.max_replicas_per_broker),
+            True,
+        )
 
     # Capacity goals
     for gid, res in G.CAPACITY_RESOURCE.items():
+        if _off(prior_mask, gid):
+            continue
         fits = gb(snap.broker_load[:, res])[None, :] + eff[:, None, res] <= gb(snap.cap_limits[:, res])[None, :]
         ok &= jnp.where(prior_mask[gid], fits, True)
 
     # ReplicaDistributionGoal
-    upper = snap.replica_band[1]
-    dst_after = gb(counts)[None, :] + 1
-    rd_ok = (dst_after <= upper) | (dst_after <= counts[src][:, None] - 1)
-    ok &= jnp.where(prior_mask[G.REPLICA_DISTRIBUTION], rd_ok, True)
+    if not _off(prior_mask, G.REPLICA_DISTRIBUTION):
+        upper = snap.replica_band[1]
+        dst_after = gb(counts)[None, :] + 1
+        rd_ok = (dst_after <= upper) | (dst_after <= counts[src][:, None] - 1)
+        ok &= jnp.where(prior_mask[G.REPLICA_DISTRIBUTION], rd_ok, True)
 
     # PotentialNwOutGoal
-    leader_nw = (
-        state.base_load[r, Resource.NW_OUT]
-        + state.leadership_delta[p, Resource.NW_OUT]
-    )
-    pnw_after = gb(snap.potential_nw_out)[None, :] + leader_nw[:, None]
-    pnw_ok = pnw_after <= gb(snap.cap_limits[:, Resource.NW_OUT])[None, :]
-    ok &= jnp.where(prior_mask[G.POTENTIAL_NW_OUT], pnw_ok, True)
+    if not _off(prior_mask, G.POTENTIAL_NW_OUT):
+        leader_nw = (
+            state.base_load[r, Resource.NW_OUT]
+            + state.leadership_delta[p, Resource.NW_OUT]
+        )
+        pnw_after = gb(snap.potential_nw_out)[None, :] + leader_nw[:, None]
+        pnw_ok = pnw_after <= gb(snap.cap_limits[:, Resource.NW_OUT])[None, :]
+        ok &= jnp.where(prior_mask[G.POTENTIAL_NW_OUT], pnw_ok, True)
 
     # ResourceDistributionGoals
     for gid, res in G.DIST_RESOURCE.items():
+        if _off(prior_mask, gid):
+            continue
         low = snap.low_util[res]
         cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
         src_before = snap.broker_load[src, res]
@@ -500,7 +589,7 @@ def move_dst_matrix(
         ok &= jnp.where(prior_mask[gid], dist_ok, True)
 
     # TopicReplicaDistributionGoal
-    if snap.enable_heavy:
+    if snap.enable_heavy and not _off(prior_mask, G.TOPIC_REPLICA_DIST):
         bt = snap.topic_counts
         tup = snap.topic_band[1]
         dst_t_after = gb(bt)[:, topic].T + 1                  # [S, cols]
@@ -510,20 +599,22 @@ def move_dst_matrix(
         ok &= jnp.where(prior_mask[G.TOPIC_REPLICA_DIST], td_ok, True)
 
     # LeaderReplicaDistributionGoal (only when the moved replica leads)
-    lupper = snap.leader_band[1]
-    l_after = gb(snap.leader_counts)[None, :] + 1
-    ld_ok = (~leads)[:, None] | (l_after <= lupper) | (
-        l_after <= snap.leader_counts[src][:, None] - 1
-    )
-    ok &= jnp.where(prior_mask[G.LEADER_REPLICA_DIST], ld_ok, True)
+    if not _off(prior_mask, G.LEADER_REPLICA_DIST):
+        lupper = snap.leader_band[1]
+        l_after = gb(snap.leader_counts)[None, :] + 1
+        ld_ok = (~leads)[:, None] | (l_after <= lupper) | (
+            l_after <= snap.leader_counts[src][:, None] - 1
+        )
+        ok &= jnp.where(prior_mask[G.LEADER_REPLICA_DIST], ld_ok, True)
 
     # LeaderBytesInDistributionGoal (only when the moved replica leads)
-    nw_in = eff[:, Resource.NW_IN]
-    lbi_after = gb(snap.leader_nw_in)[None, :] + jnp.where(leads, nw_in, 0.0)[:, None]
-    lbi_ok = (~leads)[:, None] | (lbi_after <= snap.leader_nw_in_upper) | (
-        lbi_after <= snap.leader_nw_in[src][:, None]
-    )
-    ok &= jnp.where(prior_mask[G.LEADER_BYTES_IN_DIST], lbi_ok, True)
+    if not _off(prior_mask, G.LEADER_BYTES_IN_DIST):
+        nw_in = eff[:, Resource.NW_IN]
+        lbi_after = gb(snap.leader_nw_in)[None, :] + jnp.where(leads, nw_in, 0.0)[:, None]
+        lbi_ok = (~leads)[:, None] | (lbi_after <= snap.leader_nw_in_upper) | (
+            lbi_after <= snap.leader_nw_in[src][:, None]
+        )
+        ok &= jnp.where(prior_mask[G.LEADER_BYTES_IN_DIST], lbi_ok, True)
 
     return ok & cand_valid[:, None]
 
@@ -552,7 +643,7 @@ def leadership_target_ok(
     ok = jnp.ones(R, bool)
 
     # MinTopicLeaders: the current leader's broker must keep its minimum
-    if snap.enable_heavy:
+    if snap.enable_heavy and not _off(prior_mask, G.MIN_TOPIC_LEADERS):
         protected = ctx.min_leader_topics[topic]
         after_src = snap.topic_leader_counts[leader_b, topic] - 1
         mtl_ok = ~protected | (after_src >= ctx.constraint.min_topic_leaders_per_broker)
@@ -560,11 +651,15 @@ def leadership_target_ok(
 
     # Capacity goals: the gaining broker absorbs the leadership delta
     for gid, res in G.CAPACITY_RESOURCE.items():
+        if _off(prior_mask, gid):
+            continue
         fits = snap.broker_load[b, res] + ldelta[:, res] <= snap.cap_limits[b, res]
         ok &= jnp.where(prior_mask[gid], fits | (ldelta[:, res] <= 0.0), True)
 
     # ResourceDistributionGoals
     for gid, res in G.DIST_RESOURCE.items():
+        if _off(prior_mask, gid):
+            continue
         low = snap.low_util[res]
         cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
         src_before = snap.broker_load[leader_b, res]
@@ -582,25 +677,28 @@ def leadership_target_ok(
         ok &= jnp.where(prior_mask[gid], dist_ok, True)
 
     # LeaderReplicaDistributionGoal
-    l_after = snap.leader_counts[b] + 1
-    ld_ok = (l_after <= snap.leader_band[1]) | (l_after <= snap.leader_counts[leader_b] - 1)
-    ok &= jnp.where(prior_mask[G.LEADER_REPLICA_DIST], ld_ok, True)
+    if not _off(prior_mask, G.LEADER_REPLICA_DIST):
+        l_after = snap.leader_counts[b] + 1
+        ld_ok = (l_after <= snap.leader_band[1]) | (l_after <= snap.leader_counts[leader_b] - 1)
+        ok &= jnp.where(prior_mask[G.LEADER_REPLICA_DIST], ld_ok, True)
 
     # LeaderBytesInDistributionGoal
-    nw_in = snap.eff_load[:, Resource.NW_IN]
-    lbi_after = snap.leader_nw_in[b] + nw_in
-    lbi_ok = (lbi_after <= snap.leader_nw_in_upper) | (lbi_after <= snap.leader_nw_in[leader_b])
-    ok &= jnp.where(prior_mask[G.LEADER_BYTES_IN_DIST], lbi_ok, True)
+    if not _off(prior_mask, G.LEADER_BYTES_IN_DIST):
+        nw_in = snap.eff_load[:, Resource.NW_IN]
+        lbi_after = snap.leader_nw_in[b] + nw_in
+        lbi_ok = (lbi_after <= snap.leader_nw_in_upper) | (lbi_after <= snap.leader_nw_in[leader_b])
+        ok &= jnp.where(prior_mask[G.LEADER_BYTES_IN_DIST], lbi_ok, True)
 
     # PreferredLeaderElectionGoal: only the replica-list head may take leadership
-    pref = snap.preferred_leader[p]
-    pref_safe = jnp.maximum(pref, 0)
-    pref_alive = (pref >= 0) & state.broker_alive[state.replica_broker[pref_safe]]
-    is_pref = jnp.arange(R, dtype=jnp.int32) == pref
-    ok &= jnp.where(prior_mask[G.PREFERRED_LEADER_ELECTION], ~pref_alive | is_pref, True)
+    if not _off(prior_mask, G.PREFERRED_LEADER_ELECTION):
+        pref = snap.preferred_leader[p]
+        pref_safe = jnp.maximum(pref, 0)
+        pref_alive = (pref >= 0) & state.broker_alive[state.replica_broker[pref_safe]]
+        is_pref = jnp.arange(R, dtype=jnp.int32) == pref
+        ok &= jnp.where(prior_mask[G.PREFERRED_LEADER_ELECTION], ~pref_alive | is_pref, True)
 
     # TopicLeaderReplicaDistributionGoal: gaining broker stays within its band
-    if snap.enable_heavy:
+    if snap.enable_heavy and not _off(prior_mask, G.TOPIC_LEADER_DIST):
         from cruise_control_tpu.analyzer.context import topic_leader_upper
 
         lt = snap.topic_leader_counts
@@ -608,6 +706,19 @@ def leadership_target_ok(
         after = lt[b, topic] + 1
         tld_ok = (after <= lt_up[topic]) | (after <= lt[leader_b, topic] - 1)
         ok &= jnp.where(prior_mask[G.TOPIC_LEADER_DIST], tld_ok, True)
+
+    # KafkaAssignerEvenRackAwareGoal: the transfer exchanges position 0 and the
+    # target's position between the two brokers — both directions must keep the
+    # destination strictly below the source (accept_assigner_even); same-broker
+    # transfers change no count
+    if not _off(prior_mask, G.KAFKA_ASSIGNER_RACK):
+        pc, pos_all = _assigner_even_state(state)
+        q = pos_all
+        ev_ok = (
+            (pc[0, b] + 1 <= pc[0, leader_b])
+            & (pc[q, leader_b] + 1 <= pc[q, b])
+        ) | (q == 0) | (b == leader_b)
+        ok &= jnp.where(prior_mask[G.KAFKA_ASSIGNER_RACK], ev_ok, True)
 
     return ok & state.replica_valid & (cur_leader >= 0)
 
@@ -649,19 +760,44 @@ def swap_dst_matrix(
 
     ok = jnp.ones((S, B), bool)
 
-    # RackAwareGoal — both directions, exact (distinct partitions)
-    dst_rack = state.broker_rack[None, :]
-    src_rack = state.broker_rack[src][:, None]
-    occ_fwd = snap.rack_counts[p_out][:, state.broker_rack] - (src_rack == dst_rack).astype(jnp.int32)
-    # occ_bwd[s, d] = replicas of partner[d]'s partition in slot s's source rack
-    occ_bwd = (
-        snap.rack_counts[p_in][:, state.broker_rack[src]].T
-        - (dst_rack == src_rack).astype(jnp.int32)
-    )
-    ok &= jnp.where(prior_mask[G.RACK_AWARE], (occ_fwd == 0) & (occ_bwd == 0), True)
+    # RackAwareGoal — both directions, exact (distinct partitions); the
+    # kafka-assigner mode shares the strict rack criterion
+    if not _off(prior_mask, G.RACK_AWARE, G.KAFKA_ASSIGNER_RACK):
+        dst_rack = state.broker_rack[None, :]
+        src_rack = state.broker_rack[src][:, None]
+        occ_fwd = snap.rack_counts[p_out][:, state.broker_rack] - (src_rack == dst_rack).astype(jnp.int32)
+        # occ_bwd[s, d] = replicas of partner[d]'s partition in slot s's source rack
+        occ_bwd = (
+            snap.rack_counts[p_in][:, state.broker_rack[src]].T
+            - (dst_rack == src_rack).astype(jnp.int32)
+        )
+        strict_rack = prior_mask[G.RACK_AWARE] | prior_mask[G.KAFKA_ASSIGNER_RACK]
+        ok &= jnp.where(strict_rack, (occ_fwd == 0) & (occ_bwd == 0), True)
+
+    # KafkaAssignerEvenRackAwareGoal even-placement half: a swap exchanges the
+    # two replicas' positions between the endpoint brokers; unless the
+    # positions match (count-neutral) both directions must keep the gaining
+    # broker strictly below the losing one (accept_assigner_even)
+    if not _off(prior_mask, G.KAFKA_ASSIGNER_RACK):
+        pc, pos_all = _assigner_even_state(state)
+        q_out = pos_all[r]                              # [S]
+        q_in = pos_all[q]                               # [B]
+        c_out_d = pc[q_out]                             # [S, B] counts at q_out_s
+        c_out_src = pc[q_out, src][:, None]             # [S, 1]
+        fwd = c_out_d + 1 <= c_out_src
+        c_in_src = pc[q_in][:, src].T                   # [S, B]: counts[q_in_d, src_s]
+        c_in_d = pc[q_in, jnp.arange(B)][None, :]       # [1, B]
+        bwd = c_in_src + 1 <= c_in_d
+        same_pos = q_out[:, None] == q_in[None, :]
+        same_broker = src[:, None] == jnp.arange(B)[None, :]  # count-neutral
+        ok &= jnp.where(
+            prior_mask[G.KAFKA_ASSIGNER_RACK],
+            same_pos | same_broker | (fwd & bwd),
+            True,
+        )
 
     # MinTopicLeaders — each side losing a protected leader must keep its minimum
-    if snap.enable_heavy:
+    if snap.enable_heavy and not _off(prior_mask, G.MIN_TOPIC_LEADERS):
         min_l = ctx.constraint.min_topic_leaders_per_broker
         prot_out = ctx.min_leader_topics[t_out]
         src_ok = ~(prot_out & leads_out) | (
@@ -681,6 +817,8 @@ def swap_dst_matrix(
     # Capacity goals — net load at BOTH endpoints (the source gains whenever
     # the partner is heavier in a resource the swap round doesn't optimize)
     for gid, res in G.CAPACITY_RESOURCE.items():
+        if _off(prior_mask, gid):
+            continue
         net = e_out[:, None, res] - e_in[None, :, res]      # dst gains this
         after = snap.broker_load[None, :, res] + net
         fits = (after <= snap.cap_limits[None, :, res]) | (net <= 0.0)
@@ -690,6 +828,8 @@ def swap_dst_matrix(
 
     # ResourceDistributionGoals — net deltas at both endpoints
     for gid, res in G.DIST_RESOURCE.items():
+        if _off(prior_mask, gid):
+            continue
         low = snap.low_util[res]
         cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
         net = e_out[:, None, res] - e_in[None, :, res]      # dst gains this
@@ -708,33 +848,36 @@ def swap_dst_matrix(
         ok &= jnp.where(prior_mask[gid], dist_ok, True)
 
     # PotentialNwOutGoal — net potential outbound at the destination
-    lnw_out = (
-        state.base_load[r, Resource.NW_OUT] + state.leadership_delta[p_out, Resource.NW_OUT]
-    )
-    lnw_in = (
-        state.base_load[q, Resource.NW_OUT] + state.leadership_delta[p_in, Resource.NW_OUT]
-    )
-    pnw_net = lnw_out[:, None] - lnw_in[None, :]
-    pnw_after = snap.potential_nw_out[None, :] + pnw_net
-    pnw_ok = (pnw_after <= snap.cap_limits[None, :, Resource.NW_OUT]) | (pnw_net <= 0.0)
-    ok &= jnp.where(prior_mask[G.POTENTIAL_NW_OUT], pnw_ok, True)
+    if not _off(prior_mask, G.POTENTIAL_NW_OUT):
+        lnw_out = (
+            state.base_load[r, Resource.NW_OUT] + state.leadership_delta[p_out, Resource.NW_OUT]
+        )
+        lnw_in = (
+            state.base_load[q, Resource.NW_OUT] + state.leadership_delta[p_in, Resource.NW_OUT]
+        )
+        pnw_net = lnw_out[:, None] - lnw_in[None, :]
+        pnw_after = snap.potential_nw_out[None, :] + pnw_net
+        pnw_ok = (pnw_after <= snap.cap_limits[None, :, Resource.NW_OUT]) | (pnw_net <= 0.0)
+        ok &= jnp.where(prior_mask[G.POTENTIAL_NW_OUT], pnw_ok, True)
 
     # LeaderReplicaDistributionGoal — net leader-count delta at the destination
-    net_lead = leads_out.astype(jnp.int32)[:, None] - leads_in.astype(jnp.int32)[None, :]
-    l_after = snap.leader_counts[None, :] + net_lead
-    ld_ok = (net_lead <= 0) | (l_after <= snap.leader_band[1]) | (
-        l_after <= snap.leader_counts[src][:, None] - 1
-    )
-    ok &= jnp.where(prior_mask[G.LEADER_REPLICA_DIST], ld_ok, True)
+    if not _off(prior_mask, G.LEADER_REPLICA_DIST):
+        net_lead = leads_out.astype(jnp.int32)[:, None] - leads_in.astype(jnp.int32)[None, :]
+        l_after = snap.leader_counts[None, :] + net_lead
+        ld_ok = (net_lead <= 0) | (l_after <= snap.leader_band[1]) | (
+            l_after <= snap.leader_counts[src][:, None] - 1
+        )
+        ok &= jnp.where(prior_mask[G.LEADER_REPLICA_DIST], ld_ok, True)
 
     # LeaderBytesInDistributionGoal — net leader bytes-in at the destination
-    lbi_out = jnp.where(leads_out, e_out[:, Resource.NW_IN], 0.0)
-    lbi_in = jnp.where(leads_in, e_in[:, Resource.NW_IN], 0.0)
-    lbi_net = lbi_out[:, None] - lbi_in[None, :]
-    lbi_after = snap.leader_nw_in[None, :] + lbi_net
-    lbi_ok = (lbi_net <= 0.0) | (lbi_after <= snap.leader_nw_in_upper) | (
-        lbi_after <= snap.leader_nw_in[src][:, None]
-    )
-    ok &= jnp.where(prior_mask[G.LEADER_BYTES_IN_DIST], lbi_ok, True)
+    if not _off(prior_mask, G.LEADER_BYTES_IN_DIST):
+        lbi_out = jnp.where(leads_out, e_out[:, Resource.NW_IN], 0.0)
+        lbi_in = jnp.where(leads_in, e_in[:, Resource.NW_IN], 0.0)
+        lbi_net = lbi_out[:, None] - lbi_in[None, :]
+        lbi_after = snap.leader_nw_in[None, :] + lbi_net
+        lbi_ok = (lbi_net <= 0.0) | (lbi_after <= snap.leader_nw_in_upper) | (
+            lbi_after <= snap.leader_nw_in[src][:, None]
+        )
+        ok &= jnp.where(prior_mask[G.LEADER_BYTES_IN_DIST], lbi_ok, True)
 
     return ok & cand_valid[:, None] & partner_valid[None, :]

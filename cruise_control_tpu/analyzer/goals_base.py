@@ -17,7 +17,7 @@ default priority order in ``config/constants/AnalyzerConfig.java:352-368``.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -147,89 +147,109 @@ def rack_violating_replicas(state: ClusterArrays, snap: Snapshot) -> jax.Array:
 
 
 # -- violations -------------------------------------------------------------------
+#
+# One function per goal id so a compiled program can carry exactly the rows it
+# needs (``violations_one`` — a fused per-goal dispatch embeds one goal's
+# criterion, not all 24) while ``violations_all`` assembles the full vector from
+# the same functions (identical intermediates CSE away within one trace).
+
+_EPS = 1e-6
 
 
-def violations_all(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> jax.Array:
-    """f32[NUM_GOALS]: violating-entity count per goal id (0 ⇒ goal satisfied).
+def _viol_rack_aware(state, ctx, snap):
+    return rack_violating_replicas(state, snap).sum().astype(jnp.float32)
 
-    The heavy [B, T] goals report 0 when the snapshot was taken without
-    ``enable_heavy``.
-    """
-    out = jnp.zeros(NUM_GOALS, jnp.float32)
-    alive = state.broker_alive
 
-    out = out.at[RACK_AWARE].set(rack_violating_replicas(state, snap).sum())
+def _viol_replica_capacity(state, ctx, snap):
+    over = (snap.replica_counts > ctx.constraint.max_replicas_per_broker)
+    return (over & state.broker_alive).sum().astype(jnp.float32)
 
+
+def _viol_capacity(res: int):
+    def fn(state, ctx, snap):
+        over = snap.broker_load[:, res] > snap.cap_limits[:, res] * (1 + _EPS) + _EPS
+        return (over & state.broker_alive).sum().astype(jnp.float32)
+
+    return fn
+
+
+def _viol_replica_dist(state, ctx, snap):
     counts = snap.replica_counts
-    out = out.at[REPLICA_CAPACITY].set(
-        ((counts > ctx.constraint.max_replicas_per_broker) & alive).sum()
-    )
-
-    over_cap = (snap.broker_load > snap.cap_limits * (1 + 1e-6) + 1e-6) & alive[:, None]
-    for gid, res in CAPACITY_RESOURCE.items():
-        out = out.at[gid].set(over_cap[:, res].sum())
-
     lo, up = snap.replica_band[0], snap.replica_band[1]
-    out = out.at[REPLICA_DISTRIBUTION].set(
-        (((counts > up) | (counts < lo)) & alive).sum()
-    )
+    out = ((counts > up) | (counts < lo)) & state.broker_alive
+    return out.sum().astype(jnp.float32)
 
+
+def _viol_potential_nw_out(state, ctx, snap):
     pnw_limit = snap.cap_limits[:, Resource.NW_OUT]
-    out = out.at[POTENTIAL_NW_OUT].set(
-        ((snap.potential_nw_out > pnw_limit * (1 + 1e-6) + 1e-6) & alive).sum()
-    )
+    over = snap.potential_nw_out > pnw_limit * (1 + _EPS) + _EPS
+    return (over & state.broker_alive).sum().astype(jnp.float32)
 
-    eps = 1e-6
-    outside = (snap.broker_load > snap.res_upper * (1 + eps) + eps) | (
-        snap.broker_load < snap.res_lower * (1 - eps) - eps
-    )
-    outside = outside & alive[:, None] & ~snap.low_util[None, :]
-    for gid, res in DIST_RESOURCE.items():
-        out = out.at[gid].set(outside[:, res].sum())
 
+def _viol_dist(res: int):
+    def fn(state, ctx, snap):
+        outside = (
+            snap.broker_load[:, res] > snap.res_upper[:, res] * (1 + _EPS) + _EPS
+        ) | (snap.broker_load[:, res] < snap.res_lower[:, res] * (1 - _EPS) - _EPS)
+        outside = outside & state.broker_alive & ~snap.low_util[res]
+        return outside.sum().astype(jnp.float32)
+
+    return fn
+
+
+def _viol_leader_dist(state, ctx, snap):
     llo, lup = snap.leader_band[0], snap.leader_band[1]
     lcounts = snap.leader_counts
-    out = out.at[LEADER_REPLICA_DIST].set(
-        (((lcounts > lup) | (lcounts < llo)) & alive).sum()
-    )
+    out = ((lcounts > lup) | (lcounts < llo)) & state.broker_alive
+    return out.sum().astype(jnp.float32)
 
-    out = out.at[LEADER_BYTES_IN_DIST].set(
-        ((snap.leader_nw_in > snap.leader_nw_in_upper * (1 + eps) + eps) & alive).sum()
-    )
 
-    if snap.enable_heavy:
-        bt = snap.topic_counts
-        tup = snap.topic_band[1]
-        t_over = (bt > tup[None, :]) & alive[:, None]
-        out = out.at[TOPIC_REPLICA_DIST].set(t_over.sum())
+def _viol_leader_bytes_in(state, ctx, snap):
+    over = snap.leader_nw_in > snap.leader_nw_in_upper * (1 + _EPS) + _EPS
+    return (over & state.broker_alive).sum().astype(jnp.float32)
 
-        need = ctx.constraint.min_topic_leaders_per_broker
-        deficit = jnp.maximum(0, need - snap.topic_leader_counts) * ctx.min_leader_topics[None, :]
-        deficit = jnp.where(alive[:, None], deficit, 0)
-        out = out.at[MIN_TOPIC_LEADERS].set(deficit.sum())
 
-        # TopicLeaderReplicaDistributionGoal: per-topic leader counts within a
-        # band around the per-broker average (reuses the topic-replica balance
-        # thresholds; the reference has dedicated topic.leader.* knobs)
-        from cruise_control_tpu.analyzer.context import topic_leader_upper
+def _viol_topic_replica_dist(state, ctx, snap):
+    if not snap.enable_heavy:
+        return jnp.float32(0)
+    t_over = (snap.topic_counts > snap.topic_band[1][None, :]) & state.broker_alive[:, None]
+    return t_over.sum().astype(jnp.float32)
 
-        lt = snap.topic_leader_counts
-        lt_up = topic_leader_upper(state, ctx, snap)
-        out = out.at[TOPIC_LEADER_DIST].set(
-            ((lt > lt_up[None, :]) & alive[:, None]).sum()
-        )
 
-    # PreferredLeaderElectionGoal: partitions not led by their replica-list head
-    # (when the head sits on an alive broker)
+def _viol_min_topic_leaders(state, ctx, snap):
+    if not snap.enable_heavy:
+        return jnp.float32(0)
+    need = ctx.constraint.min_topic_leaders_per_broker
+    deficit = jnp.maximum(0, need - snap.topic_leader_counts) * ctx.min_leader_topics[None, :]
+    deficit = jnp.where(state.broker_alive[:, None], deficit, 0)
+    return deficit.sum().astype(jnp.float32)
+
+
+def _viol_topic_leader_dist(state, ctx, snap):
+    # TopicLeaderReplicaDistributionGoal: per-topic leader counts within a
+    # band around the per-broker average (reuses the topic-replica balance
+    # thresholds; the reference has dedicated topic.leader.* knobs)
+    if not snap.enable_heavy:
+        return jnp.float32(0)
+    from cruise_control_tpu.analyzer.context import topic_leader_upper
+
+    lt = snap.topic_leader_counts
+    lt_up = topic_leader_upper(state, ctx, snap)
+    return ((lt > lt_up[None, :]) & state.broker_alive[:, None]).sum().astype(jnp.float32)
+
+
+def _viol_preferred_leader(state, ctx, snap):
+    # partitions not led by their replica-list head (when the head sits on an
+    # alive broker)
     pref = snap.preferred_leader
     pref_safe = jnp.maximum(pref, 0)
     pref_ok = (pref >= 0) & state.broker_alive[state.replica_broker[pref_safe]]
-    out = out.at[PREFERRED_LEADER_ELECTION].set(
-        (pref_ok & (state.partition_leader != pref)).sum()
-    )
+    return (pref_ok & (state.partition_leader != pref)).sum().astype(jnp.float32)
 
-    # RackAwareDistributionGoal: replicas spread across racks as evenly as the
-    # alive-rack count allows (relaxed rack awareness — ceil(RF / racks) per rack)
+
+def _viol_rack_dist(state, ctx, snap):
+    # replicas spread across racks as evenly as the alive-rack count allows
+    # (relaxed rack awareness — ceil(RF / racks) per rack)
     from cruise_control_tpu.analyzer.context import rack_fair_share
 
     rf_p = _segment_sum(
@@ -238,34 +258,201 @@ def violations_all(state: ClusterArrays, ctx: GoalContext, snap: Snapshot) -> ja
         num_segments=state.num_partitions,
     )
     fair = rack_fair_share(state, snap, jnp.arange(state.num_partitions))
-    out = out.at[RACK_AWARE_DISTRIBUTION].set(
-        ((snap.rack_counts.max(axis=1) > fair) & (rf_p > 0)).sum()
-    )
+    over = (snap.rack_counts.max(axis=1) > fair) & (rf_p > 0)
+    return over.sum().astype(jnp.float32)
 
-    # BrokerSetAwareGoal: replicas outside their topic's broker set
+
+def _viol_broker_set(state, ctx, snap):
     r_topic = state.partition_topic[state.replica_partition]
     want_set = ctx.broker_set_of_topic[r_topic]
     have_set = ctx.broker_set_of_broker[state.replica_broker]
-    out = out.at[BROKER_SET_AWARE].set(
-        (state.replica_valid & (want_set >= 0) & (have_set != want_set)).sum()
+    bad = state.replica_valid & (want_set >= 0) & (have_set != want_set)
+    return bad.sum().astype(jnp.float32)
+
+
+def _viol_intra_disk_capacity(state, ctx, snap):
+    if state.num_disks == 0:
+        return jnp.float32(0)
+    usable = snap.disk_usable
+    d_over = (snap.disk_load > snap.disk_limits * (1 + _EPS) + _EPS) & usable
+    # ANY replica sitting on a dead/removed logdir violates the goal —
+    # counted by replica count, not load (empty replicas must drain too)
+    stranded = snap.disk_replica_counts > 0
+    d_over = d_over | (stranded & ~usable)
+    return d_over.sum().astype(jnp.float32)
+
+
+def _viol_intra_disk_dist(state, ctx, snap):
+    if state.num_disks == 0:
+        return jnp.float32(0)
+    d_out = (
+        (snap.disk_load > snap.disk_upper * (1 + _EPS) + _EPS)
+        | (snap.disk_load < snap.disk_lower * (1 - _EPS) - _EPS)
+    ) & snap.disk_usable
+    return d_out.sum().astype(jnp.float32)
+
+
+#: positions tracked by the kafka-assigner evenness metric (max RF it scores;
+#: replicas at higher positions are rare and simply don't contribute)
+ASSIGNER_POS_CAP = 8
+
+
+def assigner_position_counts(state: ClusterArrays) -> jax.Array:
+    """i32[ASSIGNER_POS_CAP, B]: valid replicas per (position, broker) — the
+    state of the even-rack goal's ``BrokerReplicaCount`` TreeSet walk."""
+    from cruise_control_tpu.analyzer.kafka_assigner import replica_positions
+
+    B = state.num_brokers
+    pos = replica_positions(state)
+    ok = state.replica_valid & (pos >= 0) & (pos < ASSIGNER_POS_CAP)
+    group = jnp.where(ok, pos * B + state.replica_broker, ASSIGNER_POS_CAP * B)
+    return _segment_sum(
+        ok.astype(jnp.int32), group, num_segments=ASSIGNER_POS_CAP * B
+    ).reshape(ASSIGNER_POS_CAP, B)
+
+
+def assigner_position_unevenness(
+    state: ClusterArrays,
+    eligible: "jax.Array | None" = None,
+    p0_eligible: "jax.Array | None" = None,
+) -> jax.Array:
+    """f32: Σ_p max(0, maxᵦ count[p,b] − minᵦ count[p,b] − 1) over ``eligible``
+    brokers (default: alive).
+
+    The kafka-assigner even-rack goal's actual objective — per-position replica
+    counts even across brokers (``KafkaAssignerEvenRackAwareGoal.java:496-504``,
+    ``BrokerReplicaCount.compareTo``: the TreeSet walk always lands the next
+    replica on a least-loaded broker, so a finished placement has max−min ≤ 1
+    per position).  0 ⇔ every tracked position is as even as integer counts
+    allow.  ``eligible`` must match the placement's destination set (the
+    brokers the assigner may land replicas on); position 0 carries leadership,
+    so ``p0_eligible`` (default: ``eligible``) must additionally drop
+    leadership-excluded brokers — scoring a barred broker's permanent 0 would
+    make a correct placement read as violating.
+    """
+    B = state.num_brokers
+    if eligible is None:
+        eligible = state.broker_alive
+    if p0_eligible is None:
+        p0_eligible = eligible
+    counts = assigner_position_counts(state)
+    el = jnp.broadcast_to(eligible[None, :], counts.shape)
+    el = el.at[0, :].set(p0_eligible)
+    big = jnp.int32(2**30)
+    cmax = jnp.where(el, counts, -1).max(axis=1)
+    cmin = jnp.where(el, counts, big).min(axis=1)
+    has_pos = counts.sum(axis=1) > 0
+    spread = jnp.where(has_pos, jnp.maximum(cmax - cmin - 1, 0), 0)
+    return spread.sum().astype(jnp.float32)
+
+
+def _viol_assigner_rack(state, ctx, snap):
+    # rack validity (the goal is rack-aware by construction) PLUS the even-
+    # placement objective the mode exists for, scored over the brokers the
+    # mode may actually place on (kafka_assigner.even_rack_aware_assign's
+    # move_ok eligibility) — PLUS replicas stranded outside that destination
+    # set (the unassignable leftovers the reference fails fast on; excluded
+    # topics legitimately keep their placement and don't count)
+    eligible = state.broker_alive & ~ctx.excluded_for_replica_move
+    p0_eligible = eligible & ~ctx.excluded_for_leadership
+    topic_excl = ctx.excluded_topics[state.partition_topic[state.replica_partition]]
+    # rack validity scored only over replicas the mode may touch — the
+    # reference skips excluded topics entirely, so their (possibly
+    # rack-violating) placement is not this goal's failure.  Evenness keeps
+    # TOTAL counts (excluded replicas pre-seed the per-position counts,
+    # initGoalState:89-104): a residue from piled immovable seeds is honest
+    # unfixable-state reporting, like the fewer-racks-than-RF case.
+    rack_bad = rack_violating_replicas(state, snap) & ~topic_excl
+    stranded = state.replica_valid & ~topic_excl & ~eligible[state.replica_broker]
+    return (
+        rack_bad.sum().astype(jnp.float32)
+        + assigner_position_unevenness(state, eligible, p0_eligible)
+        + stranded.sum().astype(jnp.float32)
     )
 
-    # kafka-assigner compatibility goals share their base goals' criteria
-    out = out.at[KAFKA_ASSIGNER_RACK].set(out[RACK_AWARE])
-    out = out.at[KAFKA_ASSIGNER_DISK].set(out[DISK_USAGE_DIST])
 
-    if state.num_disks > 0:
-        usable = snap.disk_usable
-        d_over = (snap.disk_load > snap.disk_limits * (1 + eps) + eps) & usable
-        # ANY replica sitting on a dead/removed logdir violates the goal —
-        # counted by replica count, not load (empty replicas must drain too)
-        stranded = snap.disk_replica_counts > 0
-        d_over = d_over | (stranded & ~usable)
-        out = out.at[INTRA_DISK_CAPACITY].set(d_over.sum())
-        d_out = (
-            (snap.disk_load > snap.disk_upper * (1 + eps) + eps)
-            | (snap.disk_load < snap.disk_lower * (1 - eps) - eps)
-        ) & usable
-        out = out.at[INTRA_DISK_USAGE_DIST].set(d_out.sum())
+def _viol_assigner_disk(state, ctx, snap):
+    # KafkaAssignerDiskUsageDistributionGoal.java:111-113: brokers whose disk
+    # utilization leaves [mean·(1−m), mean·(1+m)], m = (balance_pct−1)·margin,
+    # mean = Σ load / Σ capacity over the cluster (its own band — NOT
+    # DiskUsageDistributionGoal's avg±threshold).  Low-utilization exemption
+    # kept consistent with the goal's OWN rounds (the disk-distribution
+    # rounds, which skip low-util resources): a band no round can act on must
+    # not read as a permanent violation.
+    alive = state.broker_alive
+    cap = state.broker_capacity[:, Resource.DISK]
+    load = snap.broker_load[:, Resource.DISK]
+    mean = jnp.where(alive, load, 0.0).sum() / jnp.maximum(
+        jnp.where(alive, cap, 0.0).sum(), _EPS
+    )
+    margin = (ctx.constraint.resource_balance_threshold[Resource.DISK] - 1.0) * (
+        ctx.constraint.balance_margin
+    )
+    util = load / jnp.maximum(cap, _EPS)
+    outside = (util > mean * (1 + margin) + _EPS) | (
+        util < mean * jnp.maximum(0.0, 1 - margin) - _EPS
+    )
+    return jnp.where(
+        snap.low_util[Resource.DISK],
+        jnp.float32(0),
+        (outside & alive).sum().astype(jnp.float32),
+    )
 
+
+_VIOLATION_FNS = {
+    RACK_AWARE: _viol_rack_aware,
+    MIN_TOPIC_LEADERS: _viol_min_topic_leaders,
+    REPLICA_CAPACITY: _viol_replica_capacity,
+    DISK_CAPACITY: _viol_capacity(Resource.DISK),
+    NW_IN_CAPACITY: _viol_capacity(Resource.NW_IN),
+    NW_OUT_CAPACITY: _viol_capacity(Resource.NW_OUT),
+    CPU_CAPACITY: _viol_capacity(Resource.CPU),
+    REPLICA_DISTRIBUTION: _viol_replica_dist,
+    POTENTIAL_NW_OUT: _viol_potential_nw_out,
+    DISK_USAGE_DIST: _viol_dist(Resource.DISK),
+    NW_IN_USAGE_DIST: _viol_dist(Resource.NW_IN),
+    NW_OUT_USAGE_DIST: _viol_dist(Resource.NW_OUT),
+    CPU_USAGE_DIST: _viol_dist(Resource.CPU),
+    TOPIC_REPLICA_DIST: _viol_topic_replica_dist,
+    LEADER_REPLICA_DIST: _viol_leader_dist,
+    LEADER_BYTES_IN_DIST: _viol_leader_bytes_in,
+    INTRA_DISK_CAPACITY: _viol_intra_disk_capacity,
+    INTRA_DISK_USAGE_DIST: _viol_intra_disk_dist,
+    PREFERRED_LEADER_ELECTION: _viol_preferred_leader,
+    RACK_AWARE_DISTRIBUTION: _viol_rack_dist,
+    TOPIC_LEADER_DIST: _viol_topic_leader_dist,
+    BROKER_SET_AWARE: _viol_broker_set,
+    KAFKA_ASSIGNER_RACK: _viol_assigner_rack,
+    KAFKA_ASSIGNER_DISK: _viol_assigner_disk,
+}
+
+
+def violations_one(
+    gid: int, state: ClusterArrays, ctx: GoalContext, snap: Snapshot
+) -> jax.Array:
+    """f32: violating-entity count for ONE goal id (0 ⇒ satisfied)."""
+    return _VIOLATION_FNS[gid](state, ctx, snap)
+
+
+def violations_all(
+    state: ClusterArrays,
+    ctx: GoalContext,
+    snap: Snapshot,
+    subset: Optional[Tuple[int, ...]] = None,
+) -> jax.Array:
+    """f32[NUM_GOALS]: violating-entity count per goal id (0 ⇒ goal satisfied).
+
+    ``subset`` (a static tuple of goal ids) restricts the computation to those
+    rows, leaving the rest 0 — the optimizer passes its goal list so per-goal
+    bookkeeping never pays for goals outside it (the reference likewise only
+    touches the goals it runs, GoalOptimizer.java:458); in particular a list
+    without the kafka-assigner goals skips their evenness metric's
+    replica-position sort.  The heavy [B, T] goals report 0 when the snapshot
+    was taken without ``enable_heavy``.
+    """
+    out = jnp.zeros(NUM_GOALS, jnp.float32)
+    for gid, fn in _VIOLATION_FNS.items():
+        if subset is not None and gid not in subset:
+            continue
+        out = out.at[gid].set(fn(state, ctx, snap))
     return out

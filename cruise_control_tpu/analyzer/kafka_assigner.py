@@ -114,7 +114,14 @@ def _assign_position(
 
 @partial(jax.jit, static_argnames=("max_rf",))
 def even_rack_aware_assign(state: ClusterArrays, ctx, *, max_rf: int):
-    """The full placement mode: returns (new_state, num_moves).
+    """The full placement mode: returns (new_state, num_moves, num_unassigned).
+
+    ``num_unassigned`` counts replica slots for which even the relaxed
+    (rack-ignoring) pass found no eligible broker — those replicas keep their
+    old placement, which can duplicate a partition on one broker; the
+    reference fails fast on this state (``maybeApplyMove`` throws
+    OptimizationFailureException) and callers should surface it
+    (``GoalOptimizer.optimize(raise_on_hard_failure=True)`` raises).
 
     Leadership lands on the position-0 broker (the reference moves leadership
     during position-0 assignment via LEADERSHIP_MOVEMENT, :216-218); since the
@@ -152,6 +159,10 @@ def even_rack_aware_assign(state: ClusterArrays, ctx, *, max_rf: int):
     movable = valid & (pos >= 0) & (pick >= 0)
     new_broker = jnp.where(movable, pick, state.replica_broker)
     moves = (new_broker != state.replica_broker).sum().astype(jnp.int32)
+    # slots the scan should have filled but couldn't (no eligible broker even
+    # with the rack constraint relaxed) — non-excluded replicas left in place
+    should_fill = valid & (pos >= 0) & (pos < max_rf) & ~excluded_rep
+    unassigned = (should_fill & (pick < 0)).sum().astype(jnp.int32)
 
     new_state = state.replace(replica_broker=new_broker)
     if state.num_disks:
@@ -168,4 +179,4 @@ def even_rack_aware_assign(state: ClusterArrays, ctx, *, max_rf: int):
         moved = new_broker != state.replica_broker
         new_disk = jnp.where(moved, first_alive[new_broker], state.replica_disk)
         new_state = new_state.replace(replica_disk=new_disk)
-    return new_state, moves
+    return new_state, moves, unassigned

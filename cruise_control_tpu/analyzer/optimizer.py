@@ -23,6 +23,7 @@ and ``AbstractGoal.optimize`` (AbstractGoal.java:82-135), restructured for TPU:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from functools import partial
 from typing import Dict, List, Sequence, Tuple
@@ -224,10 +225,16 @@ def movement_stats(initial: ClusterArrays, final: ClusterArrays) -> MovementStat
     inter = valid & (b0 != b1)
     intra = valid & (b0 == b1) & (d0 != d1)
     # partitions whose leader ends up on a different broker (the reference's
-    # hasLeaderAction criterion on the proposal diff, AnalyzerUtils.java:47)
+    # hasLeaderAction criterion on the proposal diff, AnalyzerUtils.java:47).
+    # partition_leader is -1 for leaderless/padded partitions (cluster.py) —
+    # those rows must not index the replica arrays (numpy -1 wraps to the
+    # last row and phantom-counts it whenever that replica moved)
     l0 = np.asarray(initial.partition_leader)
     l1 = np.asarray(final.partition_leader)
-    lead_moved = b0[l0] != b1[l1]
+    has_leader = (l0 >= 0) & (l1 >= 0)
+    lead_moved = has_leader & (
+        b0[np.maximum(l0, 0)] != b1[np.maximum(l1, 0)]
+    )
 
     return MovementStats(
         num_inter_broker_moves=int(inter.sum()),
@@ -279,15 +286,32 @@ class OptimizerResult:
 # ---------------------------------------------------------------------------
 
 
-def _phase_loop(state, ctx, prior_mask, admit_mask, *, round_fn, max_rounds, enable_heavy):
+def _np_mask(ids: Tuple[int, ...]):
+    """CONCRETE (numpy) goal mask from a static id tuple: acceptance kernels
+    skip disabled goals at trace time (acceptance._off), so each compiled phase
+    carries exactly the prior-goal terms its position needs — the rest never
+    reach XLA."""
+    import numpy as np
+
+    m = np.zeros(G.NUM_GOALS, bool)
+    if ids:
+        m[list(ids)] = True
+    return m
+
+
+def _phase_loop(state, ctx, *, round_fn, max_rounds, enable_heavy, prior_ids, admit_ids):
     """Drive one round type to convergence inside a single compiled while loop.
 
-    ``prior_mask`` gates single-action acceptance (the hard "later goals never
-    violate earlier ones" contract); ``admit_mask`` (normally prior ∪ current
-    goal) bounds the score-ordered cumulative admission that lets many actions
-    per broker land in one round (moves.admit).  The round number feeds the
-    proposers as a tie-breaking salt.
+    ``prior_ids`` (static) gates single-action acceptance (the hard "later
+    goals never violate earlier ones" contract); ``admit_ids`` (normally prior
+    ∪ current goal) bounds the score-ordered cumulative admission that lets
+    many actions per broker land in one round (moves.admit).  Static tuples —
+    the masks become trace-time constants, so disabled goals' acceptance
+    kernels are never even traced.  The round number feeds the proposers as a
+    tie-breaking salt.
     """
+    prior_mask = _np_mask(prior_ids)
+    admit_mask = _np_mask(admit_ids)
 
     # With capped sources (_cap_sources) a round only offers a rotating window
     # over the need-ranked active sources; a zero-move round therefore only
@@ -319,50 +343,77 @@ def _phase_loop(state, ctx, prior_mask, admit_mask, *, round_fn, max_rounds, ena
     return state, iters, total
 
 
-#: single-round-type phase (kept for targeted tests / ad-hoc drivers; the
-#: optimizer itself dispatches whole goals at a time via :func:`_goal_step`)
-_phase = partial(jax.jit, static_argnames=("round_fn", "max_rounds", "enable_heavy"))(
-    _phase_loop
+#: single-round-type phase — the optimizer's default dispatch unit.  Compiled
+#: per (round_fn, prior_ids) position, but each program carries ONLY the prior
+#: goals its position needs (static masks + acceptance._off trace-time skip):
+#: a full 16-goal optimize compiles ~30 small programs instead of 16 large
+#: fused ones (the round-4 fused-only layout tripled cold-compile wall on a
+#: 1-core host and blew the multichip-dryrun window; see BENCH_r04/
+#: MULTICHIP_r04).
+_phase = partial(
+    jax.jit,
+    static_argnames=("round_fn", "max_rounds", "enable_heavy", "prior_ids", "admit_ids"),
+)(_phase_loop)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "gid", "round_fns", "max_rounds", "enable_heavy", "prior_ids", "admit_ids",
+    ),
 )
+def _goal_step(
+    state, ctx, *, gid, round_fns, max_rounds, enable_heavy, prior_ids, admit_ids
+):
+    """One goal = ONE device dispatch (opt-in, ``fuse_goal_dispatch``): every
+    round-type phase of the goal run to convergence back-to-back, plus the
+    goal's OWN violation count before/after — so the host never has to come
+    back mid-goal and a whole ``optimize()`` is ~(#goals + 4) dispatches.
+    Worth it on a network-tunneled device where per-dispatch latency dominates;
+    NOT the default, because each goal becomes its own large compiled program
+    (per-goal violation scalars — not the full 24-row ``violations_all`` of the
+    round-4 layout — keep that program as small as fusion allows).
 
-
-@partial(jax.jit, static_argnames=("round_fns", "max_rounds", "enable_heavy"))
-def _goal_step(state, ctx, prior_mask, admit_mask, *, round_fns, max_rounds, enable_heavy):
-    """One goal = ONE device dispatch: every round-type phase of the goal run
-    to convergence back-to-back, then the full violations vector of the
-    resulting state — so the host never has to come back mid-goal.
-
-    This is the batched analogue of one iteration of the reference's per-goal
-    loop (GoalOptimizer.java:458-497: ``goal.optimize`` + stats bookkeeping in
-    a single pass).  Keeping the violations in the same executable means a
-    whole ``optimize()`` is ~(#goals + 3) dispatches instead of ~57, which is
-    what lets the async dispatch queue hide the tunnel latency of a remote
-    TPU: the host enqueues goal N+1 while the device still runs goal N.
+    The batched analogue of one iteration of the reference's per-goal loop
+    (GoalOptimizer.java:458-497: ``goal.optimize`` + stats bookkeeping in one
+    pass).
     """
+    snap0 = take_snapshot(state, ctx, enable_heavy)
+    before = G.violations_one(gid, state, ctx, snap0)
     rounds = jnp.int32(0)
     moves = jnp.int32(0)
     for fn in round_fns:
         state, r, m = _phase_loop(
-            state, ctx, prior_mask, admit_mask,
+            state, ctx,
             round_fn=fn, max_rounds=max_rounds, enable_heavy=enable_heavy,
+            prior_ids=prior_ids, admit_ids=admit_ids,
         )
         rounds += r
         moves += m
-    snap = take_snapshot(state, ctx, enable_heavy)
-    return state, rounds, moves, G.violations_all(state, ctx, snap)
+    snap1 = take_snapshot(state, ctx, enable_heavy)
+    after = G.violations_one(gid, state, ctx, snap1)
+    return state, rounds, moves, before, after
 
 
 @partial(jax.jit, static_argnames=("max_rf", "enable_heavy"))
 def _assigner_step(state, ctx, *, max_rf, enable_heavy):
     """KafkaAssignerEvenRackAwareGoal as one dispatch: the constructive
-    even/rack-aware placement (analyzer.kafka_assigner) + trailing violations.
+    even/rack-aware placement (analyzer.kafka_assigner) + the goal's own
+    before/after violation scalars (rack validity + per-position evenness).
     Replaces the improvement rounds entirely for this goal id — it is a full
-    placement mode, not a hill-climb (kafkaassigner/ package)."""
+    placement mode, not a hill-climb (kafkaassigner/ package).  ``unassigned``
+    counts replica slots NO eligible broker could take (fewer eligible brokers
+    than RF) — the state the reference fails fast on from ``maybeApplyMove``
+    with an OptimizationFailureException."""
     from cruise_control_tpu.analyzer.kafka_assigner import even_rack_aware_assign
 
-    state, moves = even_rack_aware_assign(state, ctx, max_rf=max_rf)
-    snap = take_snapshot(state, ctx, enable_heavy)
-    return state, jnp.int32(1), moves, G.violations_all(state, ctx, snap)
+    gid = G.KAFKA_ASSIGNER_RACK
+    snap0 = take_snapshot(state, ctx, enable_heavy)
+    before = G.violations_one(gid, state, ctx, snap0)
+    state, moves, unassigned = even_rack_aware_assign(state, ctx, max_rf=max_rf)
+    snap1 = take_snapshot(state, ctx, enable_heavy)
+    after = G.violations_one(gid, state, ctx, snap1)
+    return state, jnp.int32(1), moves, before, after, unassigned
 
 
 def _max_replication_factor(state: ClusterArrays) -> int:
@@ -379,17 +430,38 @@ def _max_replication_factor(state: ClusterArrays) -> int:
     return max(int(counts.max()), 1)
 
 
-@partial(jax.jit, static_argnames=("enable_heavy",))
-def _violations(state, ctx, enable_heavy=False):
+@partial(jax.jit, static_argnames=("enable_heavy", "subset"))
+def _violations(state, ctx, enable_heavy=False, subset=None):
     snap = take_snapshot(state, ctx, enable_heavy)
-    return G.violations_all(state, ctx, snap)
+    return G.violations_all(state, ctx, snap, subset=subset)
 
 
-def _mask_of(ids: Tuple[int, ...]) -> jax.Array:
-    m = jnp.zeros(G.NUM_GOALS, bool)
-    if ids:
-        m = m.at[jnp.asarray(list(ids), jnp.int32)].set(True)
-    return m
+# -- real per-goal durations without host sync --------------------------------------
+#
+# The reference records true per-goal optimization durations
+# (GoalOptimizer.java:457,474).  Blocking after every goal would give exact
+# times but stall the async dispatch queue; instead a tiny stamped program is
+# enqueued after each goal's last dispatch — its host callback fires when the
+# device REACHES that point in the stream (in-order execution per device), so
+# consecutive stamps bracket each goal's actual device time.  One compiled
+# program serves every goal/call (the tag is a traced scalar).
+
+_STAMP_SINK: Dict[int, List[Tuple[int, float]]] = {}
+_STAMP_LOCK = __import__("threading").Lock()
+_STAMP_IDS = __import__("itertools").count()
+
+
+def _record_stamp(run_id, tag):
+    with _STAMP_LOCK:
+        sink = _STAMP_SINK.get(int(run_id))
+        if sink is not None:
+            sink.append((int(tag), time.monotonic()))
+
+
+@jax.jit
+def _stamp(x, run_id, tag):
+    jax.debug.callback(_record_stamp, run_id, tag)
+    return x
 
 
 class GoalOptimizer:
@@ -408,6 +480,7 @@ class GoalOptimizer:
         hard_ids: Sequence[int] = G.HARD_GOALS,
         max_rounds_per_phase: int = 2000,
         enable_heavy_goals: bool = True,
+        fuse_goal_dispatch: bool | None = None,
     ) -> None:
         self.enable_heavy_goals = enable_heavy_goals
         self.goal_ids = tuple(
@@ -415,6 +488,43 @@ class GoalOptimizer:
         )
         self.hard_ids = tuple(hard_ids)
         self.max_rounds_per_phase = max_rounds_per_phase
+        # KafkaAssignerEvenRackAwareGoal is a constructive FULL placement: run
+        # anywhere but first it would silently discard every earlier goal's
+        # work, so the reference rejects such lists outright
+        # (KafkaAssignerEvenRackAwareGoal.optimize's optimizedGoals-empty check)
+        if G.KAFKA_ASSIGNER_RACK in self.goal_ids and (
+            self.goal_ids[0] != G.KAFKA_ASSIGNER_RACK
+        ):
+            raise ValueError(
+                "KafkaAssignerEvenRackAwareGoal must be the FIRST goal: it is a "
+                "constructive full placement that would clobber prior goals' "
+                f"optimizations (got position {self.goal_ids.index(G.KAFKA_ASSIGNER_RACK)})"
+            )
+        # None = decide lazily at first optimize(): the auto rule consults
+        # jax.default_backend(), which initializes the JAX runtime — doing
+        # that at construction time would block for minutes on a dead
+        # accelerator tunnel before the caller had any chance to probe
+        # (core/backend_probe.py exists precisely to prevent that)
+        self._fuse_goal_dispatch = (
+            None if fuse_goal_dispatch is None else bool(fuse_goal_dispatch)
+        )
+
+    @property
+    def fuse_goal_dispatch(self) -> bool:
+        if self._fuse_goal_dispatch is None:
+            env = os.environ.get("CC_TPU_FUSE_GOALS")
+            if env is not None:
+                self._fuse_goal_dispatch = env not in ("0", "false", "")
+            else:
+                # fused per-goal programs only pay on a network-tunneled device
+                # where per-dispatch latency dominates; per-phase programs are
+                # smaller and compile ~3× faster
+                self._fuse_goal_dispatch = jax.default_backend() in ("tpu", "axon")
+        return self._fuse_goal_dispatch
+
+    @fuse_goal_dispatch.setter
+    def fuse_goal_dispatch(self, value: bool) -> None:
+        self._fuse_goal_dispatch = bool(value)
 
     def optimize(
         self,
@@ -425,13 +535,16 @@ class GoalOptimizer:
         profile_goals: bool = False,
         on_goal_done=None,
     ) -> Tuple[ClusterArrays, OptimizerResult]:
-        """Run the goal list; one async device dispatch per goal.
+        """Run the goal list with NO host synchronization between goals.
 
-        The whole optimize is ~(#goals + 3) jitted dispatches with NO host
-        synchronization between goals (GoalOptimizer.java:458-497's one pass
-        over goals): every per-goal scalar (violations, rounds, moves) stays on
-        device until a single bulk fetch at the end, so on a network-tunneled
-        TPU the dispatch queue stays full.  ``profile_goals=True`` restores
+        Every per-goal scalar (violations, rounds, moves) stays on device until
+        a single bulk fetch at the end (GoalOptimizer.java:458-497's one pass
+        over goals), so the device dispatch queue stays full either way.  The
+        dispatch granularity is ``fuse_goal_dispatch``: per-phase programs
+        (default — small, compiled once per round type and shared across goals)
+        or one fused program per goal (~#goals+4 dispatches total, for
+        network-tunneled devices where per-dispatch latency dominates; set
+        CC_TPU_FUSE_GOALS=1/0 to override).  ``profile_goals=True`` restores
         accurate per-goal ``duration_s`` by blocking after each goal (the
         per-goal durations the reference records in OptimizerResult.java) at
         the cost of one round-trip per goal; otherwise per-goal durations
@@ -446,12 +559,12 @@ class GoalOptimizer:
 
         t0 = time.monotonic()
         heavy = self.enable_heavy_goals
+        fused = self.fuse_goal_dispatch
         initial = state
         dispatches = 0
-        viol0 = _violations(state, ctx, enable_heavy=heavy)
+        viol0 = _violations(state, ctx, enable_heavy=heavy, subset=self.goal_ids)
         dispatches += 1
         stats_before = S.cluster_model_stats(state)
-        no_prior = _mask_of(())
 
         # fast mode (OptimizationOptions.fastMode / fast.mode.per.broker.move.
         # timeout.ms): trade quality for bounded wall-clock by capping the round
@@ -465,73 +578,151 @@ class GoalOptimizer:
         # The strict pass bounds cumulative admission by the hard goals (so the
         # repair lands feasibly when it can); the relaxed pass bounds nothing —
         # draining dead brokers beats transient overload (goals rebalance after).
-        # The relaxed pass's trailing violations vector doubles as the first
-        # goal's "before", so no standalone _violations dispatch is needed.
-        hard_mask = _mask_of(tuple(g for g in self.hard_ids if g in self.goal_ids))
-        for fn, amask in (
-            ((offline_round,), hard_mask),
-            ((offline_round_relaxed,), no_prior),
+        hard_in_list = tuple(g for g in self.hard_ids if g in self.goal_ids)
+        for fn, aids in (
+            (offline_round, hard_in_list),
+            (offline_round_relaxed, ()),
         ):
-            state, _, _, viol_cur = _goal_step(
-                state, ctx, no_prior, amask,
-                round_fns=fn, max_rounds=max_rounds, enable_heavy=heavy,
+            state, _, _ = _phase(
+                state, ctx,
+                round_fn=fn, max_rounds=max_rounds, enable_heavy=heavy,
+                prior_ids=(), admit_ids=aids,
             )
             dispatches += 1
 
-        raw: List[tuple] = []
-        prior: Tuple[int, ...] = ()
-        for gid in self.goal_ids:
-            g0 = time.monotonic()
-            prior_mask = _mask_of(prior)
-            admit_mask = _mask_of(prior + (gid,))
-            viol_prev = viol_cur
-            if gid == G.KAFKA_ASSIGNER_RACK:
-                # full placement mode, not an improvement loop (kafkaassigner/)
-                state, rounds, moves, viol_cur = _assigner_step(
-                    state, ctx,
-                    max_rf=_max_replication_factor(initial),
-                    enable_heavy=heavy,
-                )
-            else:
-                state, rounds, moves, viol_cur = _goal_step(
-                    state, ctx, prior_mask, admit_mask,
-                    round_fns=GOAL_ROUNDS[gid],
-                    max_rounds=max_rounds,
-                    enable_heavy=heavy,
-                )
-            dispatches += 1
-            is_hard = gid in self.hard_ids
-            if profile_goals or (raise_on_hard_failure and is_hard):
-                jax.block_until_ready(viol_cur)
-            if raise_on_hard_failure and is_hard and float(viol_cur[gid]) > 0:
-                raise OptimizationFailure(
-                    f"{G.GOAL_NAMES[gid]} unsatisfied: "
-                    f"{float(viol_cur[gid]):.0f} violations remain"
-                )
-            dur = time.monotonic() - g0
-            raw.append((gid, viol_prev, viol_cur, rounds, moves, dur))
-            if profile_goals and on_goal_done is not None:
-                on_goal_done(
-                    G.GOAL_NAMES[gid], int(rounds), int(moves),
-                    float(viol_cur[gid]), dur,
-                )
-            prior = prior + (gid,)
-
-        # single bulk host fetch of every per-goal scalar
-        violN = viol_cur
-        viol0_np, violN_np, fetched = jax.device_get(
-            (viol0, violN, [(vp, vc, r, m) for _, vp, vc, r, m, _ in raw])
+        # Dispatch layout per goal (scalars stay on device; ONE bulk fetch at
+        # the end keeps the queue full on a network-tunneled device):
+        #  - phase mode (default): one _phase dispatch per round type, shared
+        #    compiled programs, + one full _violations per goal (its "after"
+        #    doubles as the next goal's "before" — GoalOptimizer.java:458-497's
+        #    per-goal stats bookkeeping);
+        #  - fused mode: one _goal_step dispatch per goal carrying its own
+        #    before/after scalars, + one trailing full _violations.
+        viol_cur = None if fused else _violations(
+            state, ctx, enable_heavy=heavy, subset=self.goal_ids
         )
+        if not fused:
+            dispatches += 1
+        # device-side goal-boundary stamps → true per-goal durations at
+        # profile_goals=False (GoalOptimizer.java:457,474); tag -1 brackets the
+        # start of the first goal
+        run_id = next(_STAMP_IDS)
+        with _STAMP_LOCK:
+            _STAMP_SINK[run_id] = []
+        rid = jnp.int32(run_id)
+        _stamp(state.replica_broker, rid, jnp.int32(-1))
+        try:
+            raw: List[tuple] = []
+            unassigned = None
+            prior: Tuple[int, ...] = ()
+            for gid in self.goal_ids:
+                g0 = time.monotonic()
+                if gid == G.KAFKA_ASSIGNER_RACK:
+                    # full placement mode, not an improvement loop (kafkaassigner/)
+                    state, rounds, moves, before, after, unassigned = _assigner_step(
+                        state, ctx,
+                        max_rf=_max_replication_factor(initial),
+                        enable_heavy=heavy,
+                    )
+                    dispatches += 1
+                    if not fused:
+                        viol_cur = _violations(
+                            state, ctx, enable_heavy=heavy, subset=self.goal_ids
+                        )
+                        dispatches += 1
+                elif fused:
+                    state, rounds, moves, before, after = _goal_step(
+                        state, ctx,
+                        gid=gid,
+                        round_fns=GOAL_ROUNDS[gid],
+                        max_rounds=max_rounds,
+                        enable_heavy=heavy,
+                        prior_ids=prior, admit_ids=prior + (gid,),
+                    )
+                    dispatches += 1
+                else:
+                    rounds = jnp.int32(0)
+                    moves = jnp.int32(0)
+                    before = viol_cur[gid]
+                    for round_fn in GOAL_ROUNDS[gid]:
+                        state, r, m = _phase(
+                            state, ctx,
+                            round_fn=round_fn,
+                            max_rounds=max_rounds,
+                            enable_heavy=heavy,
+                            prior_ids=prior, admit_ids=prior + (gid,),
+                        )
+                        rounds = rounds + r
+                        moves = moves + m
+                        dispatches += 1
+                    viol_cur = _violations(
+                        state, ctx, enable_heavy=heavy, subset=self.goal_ids
+                    )
+                    dispatches += 1
+                    after = viol_cur[gid]
+                is_hard = gid in self.hard_ids
+                if profile_goals or (raise_on_hard_failure and is_hard):
+                    jax.block_until_ready(after)
+                if (
+                    raise_on_hard_failure
+                    and gid == G.KAFKA_ASSIGNER_RACK
+                    and int(unassigned) > 0
+                ):
+                    # the reference's maybeApplyMove throws when no broker can take
+                    # a replica (fewer eligible brokers than RF) rather than emit
+                    # an invalid placement
+                    raise OptimizationFailure(
+                        f"KafkaAssignerEvenRackAwareGoal: {int(unassigned)} replica "
+                        "slot(s) have no eligible broker (fewer eligible alive "
+                        "brokers than the replication factor)"
+                    )
+                if raise_on_hard_failure and is_hard and float(after) > 0:
+                    raise OptimizationFailure(
+                        f"{G.GOAL_NAMES[gid]} unsatisfied: "
+                        f"{float(after):.0f} violations remain"
+                    )
+                _stamp(after, rid, jnp.int32(len(raw)))
+                dur = time.monotonic() - g0
+                raw.append((gid, before, after, rounds, moves, dur))
+                if profile_goals and on_goal_done is not None:
+                    on_goal_done(
+                        G.GOAL_NAMES[gid], int(rounds), int(moves), float(after), dur,
+                    )
+                prior = prior + (gid,)
+
+            violN = (
+                _violations(state, ctx, enable_heavy=heavy, subset=self.goal_ids)
+                if fused
+                else viol_cur
+            )
+            if fused:
+                dispatches += 1
+            # single bulk host fetch of every per-goal scalar
+            viol0_np, violN_np, fetched = jax.device_get(
+                (viol0, violN, [(vb, va, r, m) for _, vb, va, r, m, _ in raw])
+            )
+            # the fetch drained the dispatch stream; the barrier flushes any
+            # still-buffered stamp callbacks before we read them
+            jax.effects_barrier()
+        finally:
+            # any exception (hard-goal raise, dead device, user callback) must
+            # not leak the sink entry in a long-lived server process
+            with _STAMP_LOCK:
+                stamp_list = _STAMP_SINK.pop(run_id, [])
+        stamps = dict(stamp_list)
         reports: List[GoalReport] = []
         total_moves = 0
-        for (gid, _, _, _, _, dur), (vp, vc, r, m) in zip(raw, fetched):
+        for i, ((gid, _, _, _, _, dur), (vb, va, r, m)) in enumerate(zip(raw, fetched)):
+            if not profile_goals and i in stamps and (i - 1) in stamps:
+                # true device-time bracket (enqueue time otherwise)
+                dur = stamps[i] - stamps[i - 1]
             reports.append(
                 GoalReport(
                     goal_id=gid,
                     name=G.GOAL_NAMES[gid],
                     is_hard=gid in self.hard_ids,
-                    violations_before=float(vp[gid]),
-                    violations_after=float(vc[gid]),
+                    violations_before=float(vb),
+                    violations_after=float(va),
                     rounds=int(r),
                     moves_applied=int(m),
                     duration_s=dur,

@@ -12,12 +12,34 @@ whole JVM (KafkaCruiseControlMain.java:26 startup path).
 from __future__ import annotations
 
 import os
+import socket
 import subprocess
 import sys
 
 #: seconds to wait for the accelerator tunnel before falling back to CPU
 #: (override with CC_TPU_PROBE_TIMEOUT_S, e.g. for fast local boots)
 BACKEND_PROBE_TIMEOUT_S = float(os.environ.get("CC_TPU_PROBE_TIMEOUT_S", 180))
+
+#: the local relay endpoint the tunneled accelerator rides
+#: (PALLAS_AXON_POOL_IPS=127.0.0.1 + remote_compile port; override with
+#: CC_TPU_TUNNEL_ADDR=host:port)
+TUNNEL_ADDR = os.environ.get("CC_TPU_TUNNEL_ADDR", "127.0.0.1:8113")
+
+
+def _tunnel_port_open() -> bool:
+    """Fast liveness pre-check: can we even open a TCP connection to the
+    tunnel relay?  A dead relay refuses in <1 ms, so callers skip the whole
+    multi-minute subprocess probe; anything ambiguous (open, filtered,
+    unparsable address) errs toward 'maybe alive' and lets the real probe
+    decide."""
+    host, _, port = TUNNEL_ADDR.rpartition(":")
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)), timeout=2):
+            return True
+    except ConnectionRefusedError:
+        return False
+    except Exception:
+        return True  # filtered/slow/odd address — not proof of death
 
 
 def probe_backend(timeout_s: float = BACKEND_PROBE_TIMEOUT_S) -> str:
@@ -27,6 +49,8 @@ def probe_backend(timeout_s: float = BACKEND_PROBE_TIMEOUT_S) -> str:
     instead of blocking this process for its full internal retry budget; the
     probe prints the actual platform so a CPU-only machine is never labeled
     'tpu' in benchmark output."""
+    if os.environ.get("JAX_PLATFORMS", "") == "axon" and not _tunnel_port_open():
+        return "cpu"
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],

@@ -247,8 +247,18 @@ def _use_pallas(n_elems: int, num_segments: int) -> bool:
         return False
     if num_segments > MAX_RADIX_SEGMENTS:
         return False
+    if num_segments > MAX_PALLAS_SEGMENTS and flag not in ("force", "radix"):
+        # The radix kernel (2048 < B ≤ 16384) has correctness coverage in
+        # interpret mode only — it has never been compiled on a chip (the
+        # tunnel has been down; docs/ARCHITECTURE.md).  Until a committed
+        # on-TPU correctness/perf artifact exists it must NOT own the
+        # production hot path: stay on the XLA scatter and let
+        # CC_TPU_PALLAS_SEGMENTS=radix (or =force) opt in for the A/B run.
+        return False
     if flag == "force":
         return True
+    # "radix" only relaxes the >2048-segment gate above; the backend and
+    # element-count conditions still apply
     return n_elems >= MIN_PALLAS_ELEMS and _tpu_backend()
 
 

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Micro-bench: wall-clock vs num_dispatches for the two goal-dispatch modes.
+
+VERDICT r4 #1b: the round-4 restructure cut dispatches 57→19 but tripled the
+driver-captured wall (contended core + 16 large fused programs).  This script
+pins the tradeoff down as data: phase mode (default — ~30 small shared-shape
+programs, ~54 dispatches) vs fused mode (CC_TPU_FUSE_GOALS=1 — one large
+program per goal, ~20 dispatches), at bench scale on the current backend.
+
+Writes benchmarks/BENCH_DISPATCH_MODES_<platform>.json:
+  per mode: cold_s (compile-inclusive first run), warm_s, num_dispatches,
+  total_moves, balancedness — quality must be identical across modes.
+
+Run: python scripts/bench_dispatch_modes.py [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(fused: bool, state, ctx):
+    import jax
+
+    from cruise_control_tpu.analyzer import GoalOptimizer
+
+    # the two modes share some programs (offline phases, _violations); start
+    # each mode from an empty jit cache so cold_s is a fair compile comparison
+    jax.clear_caches()
+    opt = GoalOptimizer(enable_heavy_goals=True, fuse_goal_dispatch=fused)
+    t0 = time.monotonic()
+    _, res = opt.optimize(state, ctx)
+    cold = time.monotonic() - t0
+    t0 = time.monotonic()
+    _, res = opt.optimize(state, ctx)
+    warm = time.monotonic() - t0
+    return {
+        "cold_s": round(cold, 3),
+        "warm_s": round(warm, 3),
+        "num_dispatches": res.num_dispatches,
+        "total_moves": res.total_moves,
+        "balancedness": round(res.balancedness_score, 4),
+        "residual_hard_violations": sum(
+            res.violations_after[n] for n in res.violated_hard_goals
+        ),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import bench
+
+    platform = bench.ensure_live_backend()
+    state, ctx, _ = bench.build()
+
+    out = {
+        "metric": "goal_dispatch_mode_ab_100brokers_10kpartitions",
+        "platform": platform,
+        "phase_mode": measure(False, state, ctx),
+        "fused_mode": measure(True, state, ctx),
+    }
+    out["quality_identical"] = (
+        out["phase_mode"]["total_moves"] == out["fused_mode"]["total_moves"]
+        and out["phase_mode"]["balancedness"] == out["fused_mode"]["balancedness"]
+    )
+    print(json.dumps(out))
+    path = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+        f"BENCH_DISPATCH_MODES_{platform}.json",
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

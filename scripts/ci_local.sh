@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Offline mirror of .github/workflows/ci.yml's `lint` + `test` jobs for
+# machines without network access (the 1-core build box): byte-compile as the
+# lint floor (no ruff baked in) and run the fast pytest tier.
+#
+#   scripts/ci_local.sh          # lint + fast tier
+#   scripts/ci_local.sh --slow   # additionally run the slow tier
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint (compileall) =="
+python -m compileall -q cruise_control_tpu tests scripts bench.py bench_scale.py \
+  bench_sharded.py __graft_entry__.py
+
+echo "== fast tier =="
+python -m pytest tests/ -x -q -m "not slow"
+
+if [[ "${1:-}" == "--slow" ]]; then
+  echo "== slow tier =="
+  python -m pytest tests/ -q -m slow
+fi

@@ -58,12 +58,18 @@ class TestEvenRackAwareMode:
         ctx = GoalContext.build(state.num_topics, state.num_brokers)
 
         # pivotal precondition: plain rack-awareness is already satisfied, so
-        # RackAwareGoal's criterion (goals_base alias) sees zero violations
+        # RackAwareGoal's criterion sees zero violations — while the assigner
+        # goal's OWN metric (rack validity + per-position evenness,
+        # KafkaAssignerEvenRackAwareGoal.java:496-504) reports the pile-up
         from cruise_control_tpu.analyzer.context import take_snapshot
         from cruise_control_tpu.analyzer.goals_base import violations_all
 
         snap = take_snapshot(state, ctx, True)
-        assert float(violations_all(state, ctx, snap)[G.RACK_AWARE]) == 0.0
+        viol = violations_all(state, ctx, snap)
+        assert float(viol[G.RACK_AWARE]) == 0.0
+        assert float(viol[G.KAFKA_ASSIGNER_RACK]) > 0.0, (
+            "per-position unevenness must be visible to the goal's violation row"
+        )
 
         opt = GoalOptimizer(
             goal_ids=(G.KAFKA_ASSIGNER_RACK,),
@@ -85,8 +91,12 @@ class TestEvenRackAwareMode:
         for p in range(final.num_partitions):
             rs = racks[brokers[valid & (part == p)]]
             assert len(set(rs.tolist())) == len(rs)
-        # hard goal satisfied in the report
+        # hard goal satisfied in the report — under the goal's REAL metric
+        # (evenness), not the rack-validity alias: before > 0, after == 0
         assert not result.violated_hard_goals
+        rep = result.goal_reports[0]
+        assert rep.violations_before > 0
+        assert rep.violations_after == 0
 
     def test_drains_dead_broker(self):
         state, maps = _piled_but_rack_aware()
@@ -148,6 +158,88 @@ class TestEvenRackAwareMode:
         b0 = np.asarray(state.replica_broker)
         landed = valid & (rb == 5) & (b0 != 5)
         assert not landed.any(), "move-excluded broker received replicas"
+
+    def test_must_be_first_goal(self):
+        """Mid-list placement would clobber prior goals' work; the reference
+        throws IllegalArgumentException unless it runs first."""
+        import pytest
+
+        with pytest.raises(ValueError, match="FIRST"):
+            GoalOptimizer(goal_ids=(G.RACK_AWARE, G.KAFKA_ASSIGNER_RACK))
+
+    def test_unassignable_replica_fails_fast(self):
+        """RF 2 but only ONE eligible alive broker: the relaxed pass cannot
+        place the second replica anywhere — the reference's maybeApplyMove
+        throws OptimizationFailureException instead of silently emitting a
+        duplicate placement."""
+        import jax.numpy as jnp
+        import pytest
+
+        from cruise_control_tpu.analyzer.optimizer import OptimizationFailure
+
+        state, _ = _piled_but_rack_aware()
+        alive = np.asarray(state.broker_alive).copy()
+        alive[2:] = False  # only brokers 0, 1 remain
+        state = state.replace(broker_alive=jnp.asarray(alive))
+        ctx = GoalContext.build(
+            state.num_topics, state.num_brokers,
+            excluded_brokers_for_replica_move=(1,),  # ...and broker 1 is barred
+        )
+        opt = GoalOptimizer(
+            goal_ids=(G.KAFKA_ASSIGNER_RACK,),
+            hard_ids=(G.KAFKA_ASSIGNER_RACK,),
+        )
+        with pytest.raises(OptimizationFailure, match="no eligible broker"):
+            opt.optimize(state, ctx, raise_on_hard_failure=True)
+        # without raise_on_hard_failure the failure still surfaces as a
+        # violated hard goal, never a silent duplicate placement
+        final, result = opt.optimize(state, ctx)
+        rp = np.asarray(final.replica_partition)
+        rb = np.asarray(final.replica_broker)
+        valid = np.asarray(final.replica_valid)
+        keys = rp[valid].astype(np.int64) * final.num_brokers + rb[valid]
+        assert len(np.unique(keys)) == int(valid.sum()), "duplicate replica"
+        assert result.violated_hard_goals
+
+    def test_position_unevenness_metric(self):
+        """Direct unit: Σ_p max(0, max−min−1) over alive brokers."""
+        from cruise_control_tpu.analyzer.goals_base import (
+            assigner_position_unevenness,
+        )
+
+        state, _ = _piled_but_rack_aware()
+        # 6 leaders on broker 0 (others 0) → 6−0−1 = 5; same for followers on
+        # broker 1 → total 10
+        assert float(assigner_position_unevenness(state)) == 10.0
+
+    def test_disk_goal_never_undoes_evenness(self):
+        """The kafka-assigner MODE goal list (even-rack placement, then its
+        disk-distribution goal): the disk goal's moves/swaps must preserve the
+        placement's per-position evenness — the even goal is PRIOR, and its
+        acceptance kernel now enforces the even half, not just rack validity."""
+        from cruise_control_tpu.synthetic import SyntheticSpec, generate
+
+        state, _ = generate(
+            SyntheticSpec(
+                num_racks=4, num_brokers=12, num_topics=6, num_partitions=120,
+                replication_factor=2, distribution="exponential",
+                skew_brokers=4, seed=11, mean_disk=0.3,
+            )
+        )
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        opt = GoalOptimizer(
+            goal_ids=(G.KAFKA_ASSIGNER_RACK, G.KAFKA_ASSIGNER_DISK),
+            hard_ids=(G.KAFKA_ASSIGNER_RACK,),
+        )
+        final, result = opt.optimize(state, ctx)
+        # the even goal must still be satisfied AFTER the disk goal ran
+        assert not result.violated_hard_goals
+        assert result.violations_after["KafkaAssignerEvenRackAwareGoal"] == 0
+        for p in range(2):
+            counts = _position_counts(final, p)
+            assert counts.max() - counts.min() <= 1, (
+                f"position {p} unevenness after disk goal: {counts}"
+            )
 
     def test_excluded_topics_stay_put(self):
         cluster = fixtures.homogeneous_cluster(fixtures.RACK_BY_BROKER4)
