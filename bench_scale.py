@@ -39,7 +39,21 @@ def main() -> None:
                     help="skip the warm-up run (reported wall includes compile)")
     ap.add_argument("--out", type=str, default=None,
                     help="write the full per-goal artifact JSON here")
+    ap.add_argument("--max-rounds", type=int, default=None,
+                    help="GoalOptimizer.max_rounds_per_phase (bound the soft-goal "
+                         "tail so a run always terminates; residual soft counts "
+                         "are reported honestly in the artifact)")
+    ap.add_argument("--progress-out", type=str, default=None,
+                    help="append one JSON line per finished goal (implies "
+                         "--profile): an interrupted run still leaves a "
+                         "per-goal artifact")
     args = ap.parse_args()
+    if args.progress_out:
+        args.profile = True
+        # fail fast on an unwritable path — discovering it when the first goal
+        # finishes (hours in at config-#4 scale) would lose the very artifact
+        # the flag exists to protect
+        open(args.progress_out, "a").close()
 
     if args.cpu:
         import jax
@@ -127,12 +141,17 @@ def main() -> None:
         ctx_kw["max_active_brokers"] = args.max_active
     ctx = GoalContext.build(state.num_topics, state.num_brokers, **ctx_kw)
 
-    opt = GoalOptimizer(goal_ids=goal_ids, enable_heavy_goals=heavy)
+    opt_kw = {}
+    if args.max_rounds is not None:
+        opt_kw["max_rounds_per_phase"] = args.max_rounds
+    opt = GoalOptimizer(goal_ids=goal_ids, enable_heavy_goals=heavy, **opt_kw)
     compile_s = None
     if not args.no_warmup:
         t0 = time.monotonic()
         opt.optimize(state, ctx)
         compile_s = time.monotonic() - t0
+    run_t0 = time.monotonic()
+
     def _progress(name, rounds, moves, after, dur):
         import sys
 
@@ -141,6 +160,13 @@ def main() -> None:
             f"violations_after={after:.0f} {dur:.1f}s",
             file=sys.stderr, flush=True,
         )
+        if args.progress_out:
+            with open(args.progress_out, "a") as f:
+                f.write(json.dumps({
+                    "goal": name, "rounds": rounds, "moves": moves,
+                    "violations_after": after, "duration_s": round(dur, 1),
+                    "elapsed_s": round(time.monotonic() - run_t0, 1),
+                }) + "\n")
 
     t0 = time.monotonic()
     final, result = opt.optimize(
@@ -152,11 +178,13 @@ def main() -> None:
     residual_hard = sum(
         result.violations_after[name] for name in result.violated_hard_goals
     )
+    residual_soft = result.residual_soft_violations
     line = {
         "metric": f"rebalance_wall_s_{brokers}brokers_{partitions}partitions",
         "value": round(wall, 3),
         "unit": "s",
         "residual_hard_violations": residual_hard,
+        "residual_soft_violations": residual_soft,
         "total_moves": result.total_moves,
         "total_rounds": sum(r.rounds for r in result.goal_reports),
         "inter_broker_moves": result.movement.num_inter_broker_moves,

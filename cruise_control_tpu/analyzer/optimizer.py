@@ -268,6 +268,13 @@ class OptimizerResult:
         return [r.name for r in self.goal_reports if r.is_hard and not r.satisfied]
 
     @property
+    def residual_soft_violations(self) -> float:
+        """Sum of end-state violations over the soft goals in the run."""
+        return sum(
+            r.violations_after for r in self.goal_reports if not r.is_hard
+        )
+
+    @property
     def balancedness_score(self) -> float:
         """Balancedness gauge ∈ [0, 100]: MAX minus the weighted cost of each
         violated goal, mirroring ``KafkaCruiseControlUtils.balancednessCostByGoal``
@@ -365,14 +372,15 @@ _phase = partial(
 def _goal_step(
     state, ctx, *, gid, round_fns, max_rounds, enable_heavy, prior_ids, admit_ids
 ):
-    """One goal = ONE device dispatch (opt-in, ``fuse_goal_dispatch``): every
-    round-type phase of the goal run to convergence back-to-back, plus the
-    goal's OWN violation count before/after — so the host never has to come
-    back mid-goal and a whole ``optimize()`` is ~(#goals + 4) dispatches.
-    Worth it on a network-tunneled device where per-dispatch latency dominates;
-    NOT the default, because each goal becomes its own large compiled program
-    (per-goal violation scalars — not the full 24-row ``violations_all`` of the
-    round-4 layout — keep that program as small as fusion allows).
+    """One goal = ONE device dispatch (the default, ``fuse_goal_dispatch``):
+    every round-type phase of the goal run to convergence back-to-back, plus
+    the goal's OWN violation count before/after — so the host never has to
+    come back mid-goal and a whole ``optimize()`` is ~(#goals + 4) dispatches.
+    Carrying per-goal violation scalars with a static prior set — not the full
+    24-row ``violations_all`` of the round-4 layout — keeps each program small
+    enough that fusion now wins on compile AND run time (see
+    benchmarks/BENCH_DISPATCH_MODES_cpu.json); CC_TPU_FUSE_GOALS=0 restores
+    the per-phase layout.
 
     The batched analogue of one iteration of the reference's per-goal loop
     (GoalOptimizer.java:458-497: ``goal.optimize`` + stats bookkeeping in one
@@ -500,11 +508,8 @@ class GoalOptimizer:
                 "constructive full placement that would clobber prior goals' "
                 f"optimizations (got position {self.goal_ids.index(G.KAFKA_ASSIGNER_RACK)})"
             )
-        # None = decide lazily at first optimize(): the auto rule consults
-        # jax.default_backend(), which initializes the JAX runtime — doing
-        # that at construction time would block for minutes on a dead
-        # accelerator tunnel before the caller had any chance to probe
-        # (core/backend_probe.py exists precisely to prevent that)
+        # None = resolve lazily (env override read at first use, never at
+        # construction — constructors must stay free of backend/env coupling)
         self._fuse_goal_dispatch = (
             None if fuse_goal_dispatch is None else bool(fuse_goal_dispatch)
         )
@@ -513,13 +518,15 @@ class GoalOptimizer:
     def fuse_goal_dispatch(self) -> bool:
         if self._fuse_goal_dispatch is None:
             env = os.environ.get("CC_TPU_FUSE_GOALS")
-            if env is not None:
-                self._fuse_goal_dispatch = env not in ("0", "false", "")
-            else:
-                # fused per-goal programs only pay on a network-tunneled device
-                # where per-dispatch latency dominates; per-phase programs are
-                # smaller and compile ~3× faster
-                self._fuse_goal_dispatch = jax.default_backend() in ("tpu", "axon")
+            # fused wins on every axis now that the per-goal program carries
+            # only its own violation scalars and a static prior set (measured,
+            # benchmarks/BENCH_DISPATCH_MODES_cpu.json: cold 133s vs 166s,
+            # warm 0.55s vs 0.68s, 8-dev dryrun 2m43 vs 3m04, identical
+            # output) — and its ~20 dispatches are what hide tunnel latency
+            # on a remote device.  CC_TPU_FUSE_GOALS=0 restores per-phase.
+            self._fuse_goal_dispatch = (
+                env not in ("0", "false", "") if env is not None else True
+            )
         return self._fuse_goal_dispatch
 
     @fuse_goal_dispatch.setter
@@ -540,11 +547,10 @@ class GoalOptimizer:
         Every per-goal scalar (violations, rounds, moves) stays on device until
         a single bulk fetch at the end (GoalOptimizer.java:458-497's one pass
         over goals), so the device dispatch queue stays full either way.  The
-        dispatch granularity is ``fuse_goal_dispatch``: per-phase programs
-        (default — small, compiled once per round type and shared across goals)
-        or one fused program per goal (~#goals+4 dispatches total, for
-        network-tunneled devices where per-dispatch latency dominates; set
-        CC_TPU_FUSE_GOALS=1/0 to override).  ``profile_goals=True`` restores
+        dispatch granularity is ``fuse_goal_dispatch``: one fused program per
+        goal (default — ~#goals+4 dispatches total) or per-phase programs
+        (CC_TPU_FUSE_GOALS=0 — more, smaller programs; kept as the fallback
+        layout).  ``profile_goals=True`` restores
         accurate per-goal ``duration_s`` by blocking after each goal (the
         per-goal durations the reference records in OptimizerResult.java) at
         the cost of one round-trip per goal; otherwise per-goal durations

@@ -49,7 +49,15 @@ def probe_backend(timeout_s: float = BACKEND_PROBE_TIMEOUT_S) -> str:
     instead of blocking this process for its full internal retry budget; the
     probe prints the actual platform so a CPU-only machine is never labeled
     'tpu' in benchmark output."""
-    if os.environ.get("JAX_PLATFORMS", "") == "axon" and not _tunnel_port_open():
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if plats.split(",")[0] == "cpu":
+        # pinned to the host CPU: nothing to probe — and spawning a probe
+        # interpreter on this box is never free (the axon sitecustomize
+        # registration dials the dead relay at startup and blocks for minutes
+        # regardless of JAX_PLATFORMS).  Other pins (e.g. real libtpu) still
+        # go through the subprocess probe so a broken backend falls back.
+        return "cpu"
+    if "axon" in plats.split(",") and not _tunnel_port_open():
         return "cpu"
     try:
         proc = subprocess.run(

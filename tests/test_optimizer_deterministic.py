@@ -352,3 +352,35 @@ class TestSwapSourceSideAcceptance:
         cpu = np.asarray(A.broker_load(final))[:, Resource.CPU]
         assert cpu[0] <= 70.0 + 1e-3, f"swap pushed source over the CPU limit: {cpu}"
         assert result.violations_after["CpuCapacityGoal"] == 0
+
+
+class TestDispatchModeEquivalence:
+    """Fused (default) and per-phase (CC_TPU_FUSE_GOALS=0) dispatch must be
+    pure execution layouts: identical placements, reports and violations."""
+
+    @pytest.mark.parametrize("fused", [True, False])
+    def test_both_modes_agree(self, fused):
+        from cruise_control_tpu.synthetic import SyntheticSpec, generate
+
+        state, _ = generate(
+            SyntheticSpec(
+                num_racks=4, num_brokers=12, num_topics=6, num_partitions=96,
+                replication_factor=3, distribution="exponential",
+                skew_brokers=4, seed=5,
+            )
+        )
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        opt = GoalOptimizer(enable_heavy_goals=True, fuse_goal_dispatch=fused)
+        final, result = opt.optimize(state, ctx)
+        key = [
+            (r.name, r.violations_before, r.violations_after, r.rounds,
+             r.moves_applied)
+            for r in result.goal_reports
+        ]
+        placement = np.asarray(final.replica_broker).tolist()
+        if not hasattr(TestDispatchModeEquivalence, "_seen"):
+            TestDispatchModeEquivalence._seen = (key, placement)
+        else:
+            k0, p0 = TestDispatchModeEquivalence._seen
+            assert key == k0, "per-goal reports differ between dispatch modes"
+            assert placement == p0, "placements differ between dispatch modes"
