@@ -236,6 +236,63 @@ def replica_dist_shed(
     )
 
 
+def replica_dist_relieve(
+    state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
+    prior_mask: jax.Array, salt: jax.Array,
+) -> MoveBatch:
+    """Swap resource headroom onto under-band brokers so the fill phase can land.
+
+    The count-fill deadlock, measured at config-3 scale: every residual
+    under-count broker sat AT the disk-capacity limit (few, huge replicas), so
+    every inbound move was vetoed by the DiskCapacityGoal prior — while every
+    disk-light broker sat at the count band's upper edge, so no outbound MOVE
+    from the stuck brokers was legal either (destination would leave the
+    band).  No single move can improve that state; a count-neutral SWAP can:
+    exchange a stuck broker's heaviest-disk replica for a light one from any
+    broker with disk headroom.  After one or two such swaps the stuck broker
+    has headroom and ``replica_dist_fill`` (run again after this phase)
+    closes the count violation.  Sources: under-band brokers within ~5% of a
+    capacity limit; gain = net disk shed.
+    """
+    lo, _up = snap.replica_band[0], snap.replica_band[1]
+    counts = snap.replica_counts
+    # DISK-gated on purpose: the swap's remedy is disk headroom (out/in scores
+    # and gain are eff_disk), so the trigger must be the disk fraction — a
+    # broker pinned on CPU/NW capacity would only receive junk disk swaps here
+    disk_frac = (
+        snap.broker_load[:, Resource.DISK]
+        / jnp.maximum(snap.cap_limits[:, Resource.DISK], 1e-9)
+    )
+    src_need = jnp.where(
+        counts < lo, jnp.maximum(disk_frac - 0.95, 0.0), 0.0
+    ).astype(jnp.float32)
+    eff_disk = snap.eff_load[:, Resource.DISK]
+    # a swap must free a MEANINGFUL slice of the source's capacity (0.1%),
+    # or the phase grinds thousands of near-zero-gain swaps at its round cap
+    # instead of converging once the useful headroom is freed
+    min_gain = 1e-3 * snap.cap_limits[:, Resource.DISK]
+    # heavy replicas must land on count-HEALTHY brokers only: an under-band
+    # destination would absorb disk it needs free for its own fill, turn
+    # resource-full, become a relieve source itself and swap the load back —
+    # an intra-phase ping-pong that burns the round cap without converging
+    dst_count_ok = (counts >= lo)[None, :]
+
+    def gain_fn(r_out: jax.Array, partner: jax.Array):
+        net = eff_disk[r_out][:, None] - eff_disk[partner][None, :]
+        src = state.replica_broker[r_out]
+        return (net > min_gain[src][:, None]) & dst_count_ok, net
+
+    return swap_round(
+        state, ctx, snap, prior_mask, salt,
+        src_need=src_need,
+        out_score=eff_disk,                # heaviest out
+        out_ok=snap.movable,
+        in_score=-eff_disk,                # lightest partner in
+        in_ok=snap.movable,
+        gain_fn=gain_fn,
+    )
+
+
 def replica_dist_fill(
     state: ClusterArrays, ctx: GoalContext, snap: Snapshot,
     prior_mask: jax.Array, salt: jax.Array,
@@ -775,7 +832,14 @@ GOAL_ROUNDS: Dict[int, Tuple[RoundFn, ...]] = {
         _capacity_move_round(Resource.CPU),
         _capacity_swap_round(Resource.CPU),
     ),
-    G.REPLICA_DISTRIBUTION: (replica_dist_shed, replica_dist_fill),
+    # shed/fill/relieve CYCLE (optimizer.MAX_GOAL_PASSES): relieve's swaps
+    # free capacity headroom on count-starved brokers, the next pass's
+    # shed/fill moves consume it
+    G.REPLICA_DISTRIBUTION: (
+        replica_dist_shed,
+        replica_dist_fill,
+        replica_dist_relieve,
+    ),
     G.POTENTIAL_NW_OUT: (potential_nw_out_round,),
     G.DISK_USAGE_DIST: (
         _dist_shed_round(Resource.DISK),

@@ -48,6 +48,10 @@ from cruise_control_tpu.model.arrays import ClusterArrays
 
 
 FAST_MODE_MAX_ROUNDS = 64
+#: cap on phase-cycle repetitions per goal (fused and phase mode alike); a
+#: pass that applies zero actions ends the cycle early, so the cap only binds
+#: when phases keep unlocking each other
+MAX_GOAL_PASSES = 8
 
 
 class OptimizationFailure(Exception):
@@ -388,16 +392,42 @@ def _goal_step(
     """
     snap0 = take_snapshot(state, ctx, enable_heavy)
     before = G.violations_one(gid, state, ctx, snap0)
-    rounds = jnp.int32(0)
-    moves = jnp.int32(0)
-    for fn in round_fns:
-        state, r, m = _phase_loop(
-            state, ctx,
-            round_fn=fn, max_rounds=max_rounds, enable_heavy=enable_heavy,
-            prior_ids=prior_ids, admit_ids=admit_ids,
+
+    # Phases repeat as a CYCLE until a full pass applies no action (or
+    # MAX_GOAL_PASSES).  One pass suffices for most goals, but phases can
+    # unlock each other — e.g. ReplicaDistribution's relieve swaps free
+    # capacity headroom that the next pass's shed/fill moves consume
+    # (goal_rounds.replica_dist_relieve); the reference's while(!_finished)
+    # sweep re-visits brokers the same way (AbstractGoal.java:98-103).
+    def one_pass(carry):
+        state, rounds, moves, _, it = carry
+        pass_moves = jnp.int32(0)
+        for fn in round_fns:
+            state, r, m = _phase_loop(
+                state, ctx,
+                round_fn=fn, max_rounds=max_rounds, enable_heavy=enable_heavy,
+                prior_ids=prior_ids, admit_ids=admit_ids,
+            )
+            rounds += r
+            moves += m
+            pass_moves += m
+        return state, rounds, moves, pass_moves, it + 1
+
+    def keep_going(carry):
+        _, _, _, pass_moves, it = carry
+        return (pass_moves > 0) & (it < MAX_GOAL_PASSES)
+
+    if len(round_fns) == 1:
+        # a single phase already ran to convergence — a second pass over
+        # unchanged state is provably a zero-move rotation; skip the cycle
+        state, rounds, moves, _, _ = one_pass(
+            (state, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0))
         )
-        rounds += r
-        moves += m
+    else:
+        state, rounds, moves, _, _ = jax.lax.while_loop(
+            keep_going, one_pass,
+            (state, jnp.int32(0), jnp.int32(0), jnp.int32(1), jnp.int32(0)),
+        )
     snap1 = take_snapshot(state, ctx, enable_heavy)
     after = G.violations_one(gid, state, ctx, snap1)
     return state, rounds, moves, before, after
@@ -650,17 +680,26 @@ class GoalOptimizer:
                     rounds = jnp.int32(0)
                     moves = jnp.int32(0)
                     before = viol_cur[gid]
-                    for round_fn in GOAL_ROUNDS[gid]:
-                        state, r, m = _phase(
-                            state, ctx,
-                            round_fn=round_fn,
-                            max_rounds=max_rounds,
-                            enable_heavy=heavy,
-                            prior_ids=prior, admit_ids=prior + (gid,),
-                        )
-                        rounds = rounds + r
-                        moves = moves + m
-                        dispatches += 1
+                    n_passes = 1 if len(GOAL_ROUNDS[gid]) == 1 else MAX_GOAL_PASSES
+                    for _pass in range(n_passes):
+                        pass_moves = jnp.int32(0)
+                        for round_fn in GOAL_ROUNDS[gid]:
+                            state, r, m = _phase(
+                                state, ctx,
+                                round_fn=round_fn,
+                                max_rounds=max_rounds,
+                                enable_heavy=heavy,
+                                prior_ids=prior, admit_ids=prior + (gid,),
+                            )
+                            rounds = rounds + r
+                            moves = moves + m
+                            pass_moves = pass_moves + m
+                            dispatches += 1
+                        # host sync per PASS (not per phase): single-pass goals
+                        # pay one extra round trip, cycling goals need the
+                        # verdict to know whether to go again
+                        if int(pass_moves) == 0:
+                            break
                     viol_cur = _violations(
                         state, ctx, enable_heavy=heavy, subset=self.goal_ids
                     )
