@@ -502,6 +502,35 @@ def _stamp(x, run_id, tag):
     return x
 
 
+_STAMPS_SUPPORTED: bool | None = None
+
+
+def _stamps_supported() -> bool:
+    """Whether the default backend can run host callbacks at all.
+
+    The tunneled accelerator's PJRT plugin ('axon') rejects send/recv host
+    callbacks with UNIMPLEMENTED; there the per-goal durations fall back to
+    enqueue time (the documented profile_goals=False degraded mode) instead
+    of crashing the whole optimize."""
+    global _STAMPS_SUPPORTED
+    if _STAMPS_SUPPORTED is None:
+        probe_id = next(_STAMP_IDS)
+        with _STAMP_LOCK:
+            _STAMP_SINK[probe_id] = []
+        try:
+            jax.block_until_ready(
+                _stamp(jnp.zeros((), jnp.int32), jnp.int32(probe_id), jnp.int32(0))
+            )
+            jax.effects_barrier()
+            _STAMPS_SUPPORTED = True
+        except Exception:
+            _STAMPS_SUPPORTED = False
+        finally:
+            with _STAMP_LOCK:
+                _STAMP_SINK.pop(probe_id, None)
+    return _STAMPS_SUPPORTED
+
+
 class GoalOptimizer:
     """Runs a prioritized goal list over a cluster snapshot.
 
@@ -642,11 +671,13 @@ class GoalOptimizer:
         # device-side goal-boundary stamps → true per-goal durations at
         # profile_goals=False (GoalOptimizer.java:457,474); tag -1 brackets the
         # start of the first goal
+        stamps_ok = _stamps_supported()
         run_id = next(_STAMP_IDS)
         with _STAMP_LOCK:
             _STAMP_SINK[run_id] = []
         rid = jnp.int32(run_id)
-        _stamp(state.replica_broker, rid, jnp.int32(-1))
+        if stamps_ok:
+            _stamp(state.replica_broker, rid, jnp.int32(-1))
         try:
             raw: List[tuple] = []
             unassigned = None
@@ -726,7 +757,8 @@ class GoalOptimizer:
                         f"{G.GOAL_NAMES[gid]} unsatisfied: "
                         f"{float(after):.0f} violations remain"
                     )
-                _stamp(after, rid, jnp.int32(len(raw)))
+                if stamps_ok:
+                    _stamp(after, rid, jnp.int32(len(raw)))
                 dur = time.monotonic() - g0
                 raw.append((gid, before, after, rounds, moves, dur))
                 if profile_goals and on_goal_done is not None:

@@ -169,10 +169,13 @@ def _seg_radix_kernel(vals_ref, out_ref, *, n_cols, n_hi):
     lo_iota = jax.lax.broadcasted_iota(jnp.int32, (_TR, _L), dimension=1)
     onehot_lo = (lo.T == lo_iota).astype(jnp.float32)   # f32[_TR, _L]
 
-    # A[c, h, r] = values[c, r] · onehot_hi[h, r] — leading-dim merge is a
-    # layout no-op (lane dim _TR untouched)
-    a = tile[:n_cols, None, :] * onehot_hi[None, :, :]
-    a = a.reshape(n_cols * n_hi, _TR)
+    # A[c·n_hi + h, r] = values[c, r] · onehot_hi[h, r].  Built as a static
+    # per-column loop of 2-D [1, _TR] × [n_hi, _TR] broadcasts (n_cols ≤ 7):
+    # the 3-D broadcast form lowers to a gather Mosaic rejects on real TPUs
+    # (interpret mode accepted it — caught in the first on-chip run).
+    a = jnp.concatenate(
+        [tile[c : c + 1, :] * onehot_hi for c in range(n_cols)], axis=0
+    )                                                   # f32[n_cols·n_hi, _TR]
 
     acc = jax.lax.dot_general(
         a,

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""On-chip A/B of the segment-sum paths: XLA scatter vs flat one-hot vs radix.
+
+Runs COMPILED on the attached accelerator (refuses to run on CPU — the whole
+point is chip evidence; interpret-mode numbers are meaningless).  For each
+bench shape (BASELINE.md configs #2/#3/#4: R=30k/B=100, R=300k/B=1k,
+R=3M/B=10k) it:
+
+  1. checks each Pallas kernel's output against ``jax.ops.segment_sum``
+     (compiled, on chip — the correctness evidence the radix gate in
+     ``ops/segments.py`` has been waiting for), and
+  2. times steady-state wall per call (median of ``reps``, after warm-up).
+
+Writes ``benchmarks/BENCH_SEGMENTS_AB_<platform>.json`` and prints it.
+
+Counterpart: the reference's per-broker load accounting hot path
+(``ClusterModel.java:1332`` utilizationMatrix) that these kernels exist to
+beat.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.ops.segments import (
+    MAX_PALLAS_SEGMENTS,
+    MAX_RADIX_SEGMENTS,
+    segment_sum_pallas,
+    segment_sum_radix,
+)
+
+SHAPES = [
+    dict(name="config2", R=30_000, B=100),
+    dict(name="config3", R=300_000, B=1_000),
+    dict(name="config4", R=3_000_000, B=10_000),
+]
+COLS = 4          # the solver's load matrix is [R, 4]
+REPS = 20
+WARMUP = 3
+
+
+@jax.jit
+def _xla_scatter(values, seg, *, num_segments):
+    return jax.ops.segment_sum(values, seg, num_segments=num_segments)
+
+
+def _time(fn, *args) -> float:
+    for _ in range(WARMUP):
+        jax.block_until_ready(fn(*args))
+    samples = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
+
+
+def main() -> None:
+    platform = jax.default_backend()
+    if platform not in ("tpu", "axon"):
+        raise SystemExit(
+            f"refusing to run on backend {platform!r}: this bench exists to "
+            "produce on-chip evidence (set JAX_PLATFORMS to the accelerator)"
+        )
+    dev = jax.devices()[0]
+    rows = []
+    for shape in SHAPES:
+        R, B = shape["R"], shape["B"]
+        rng = np.random.default_rng(7)
+        values = jnp.asarray(rng.exponential(1.0, size=(R, COLS)), jnp.float32)
+        seg = jnp.asarray(rng.integers(0, B, size=R), jnp.int32)
+
+        scatter = lambda v, s: _xla_scatter(v, s, num_segments=B)
+        ref = np.asarray(scatter(values, seg))
+        row = dict(shape, cols=COLS, xla_scatter_s=_time(scatter, values, seg))
+
+        def check(tag, fn):
+            out = np.asarray(jax.block_until_ready(fn(values, seg, B)))
+            err = float(np.max(np.abs(out - ref) / np.maximum(np.abs(ref), 1.0)))
+            row[f"{tag}_max_rel_err"] = err
+            row[f"{tag}_ok"] = bool(err < 1e-5)
+            row[f"{tag}_s"] = _time(lambda v, s: fn(v, s, B), values, seg)
+            row[f"{tag}_speedup_vs_scatter"] = round(
+                row["xla_scatter_s"] / row[f"{tag}_s"], 3
+            )
+
+        if B <= MAX_PALLAS_SEGMENTS:
+            check("flat", segment_sum_pallas)
+        if B <= MAX_RADIX_SEGMENTS:
+            check("radix", segment_sum_radix)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    out = {
+        "bench": "segment_sum_ab",
+        "platform": platform,
+        "device": str(dev),
+        "reps": REPS,
+        "rows": rows,
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+        f"BENCH_SEGMENTS_AB_{platform}.json",
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
