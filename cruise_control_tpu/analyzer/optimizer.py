@@ -279,6 +279,14 @@ class OptimizerResult:
         )
 
     @property
+    def residual_hard_violations(self) -> float:
+        """End-state violation sum over the violated hard goals (the
+        any-increase-fails metric of the obs regression gate)."""
+        return sum(
+            self.violations_after[n] for n in self.violated_hard_goals
+        )
+
+    @property
     def balancedness_score(self) -> float:
         """Balancedness gauge ∈ [0, 100]: MAX minus the weighted cost of each
         violated goal, mirroring ``KafkaCruiseControlUtils.balancednessCostByGoal``
@@ -621,7 +629,9 @@ class GoalOptimizer:
         streams per-goal OptimizationForGoal progress steps.
         """
         from cruise_control_tpu.core.sensors import PROPOSAL_COMPUTATION_TIMER, REGISTRY
+        from cruise_control_tpu.obs import recorder as obs
 
+        trace_token = obs.start_trace("optimize")
         t0 = time.monotonic()
         heavy = self.enable_heavy_goals
         fused = self.fuse_goal_dispatch
@@ -678,12 +688,18 @@ class GoalOptimizer:
         rid = jnp.int32(run_id)
         if stamps_ok:
             _stamp(state.replica_broker, rid, jnp.int32(-1))
+        # flight-recorder accounting: dispatches enqueued before the goal loop
+        # (initial violations + offline pre-phases [+ per-phase-mode violations])
+        # become the "setup" span; each goal's enqueue delta becomes its span
+        setup_dispatches = dispatches
+        setup_s = time.monotonic() - t0
         try:
             raw: List[tuple] = []
             unassigned = None
             prior: Tuple[int, ...] = ()
             for gid in self.goal_ids:
                 g0 = time.monotonic()
+                d0 = dispatches
                 if gid == G.KAFKA_ASSIGNER_RACK:
                     # full placement mode, not an improvement loop (kafkaassigner/)
                     state, rounds, moves, before, after, unassigned = _assigner_step(
@@ -760,7 +776,7 @@ class GoalOptimizer:
                 if stamps_ok:
                     _stamp(after, rid, jnp.int32(len(raw)))
                 dur = time.monotonic() - g0
-                raw.append((gid, before, after, rounds, moves, dur))
+                raw.append((gid, before, after, rounds, moves, dur, dispatches - d0))
                 if profile_goals and on_goal_done is not None:
                     on_goal_done(
                         G.GOAL_NAMES[gid], int(rounds), int(moves), float(after), dur,
@@ -776,11 +792,33 @@ class GoalOptimizer:
                 dispatches += 1
             # single bulk host fetch of every per-goal scalar
             viol0_np, violN_np, fetched = jax.device_get(
-                (viol0, violN, [(vb, va, r, m) for _, vb, va, r, m, _ in raw])
+                (viol0, violN, [(vb, va, r, m) for _, vb, va, r, m, _, _ in raw])
             )
             # the fetch drained the dispatch stream; the barrier flushes any
             # still-buffered stamp callbacks before we read them
             jax.effects_barrier()
+        except OptimizationFailure as e:
+            # a hard-goal abort still leaves a flight record: the spans walked
+            # so far plus the refusing goal itself (both raise sites are inside
+            # the goal loop, so gid/g0/d0 name the aborted goal), keeping the
+            # span-dispatch-sum == num_dispatches invariant on the error path
+            obs.finish_trace(
+                trace_token,
+                spans=[
+                    obs.Span("setup", "setup", setup_s, setup_dispatches)
+                ] + [
+                    obs.Span(G.GOAL_NAMES[g], "goal", dur, gd)
+                    for g, _, _, _, _, dur, gd in raw
+                ] + [
+                    obs.Span(
+                        G.GOAL_NAMES[gid], "aborted",
+                        time.monotonic() - g0, dispatches - d0,
+                        attrs={"error": str(e)},
+                    )
+                ],
+                attrs={"error": str(e), "num_dispatches": dispatches},
+            )
+            raise
         finally:
             # any exception (hard-goal raise, dead device, user callback) must
             # not leak the sink entry in a long-lived server process
@@ -788,8 +826,11 @@ class GoalOptimizer:
                 stamp_list = _STAMP_SINK.pop(run_id, [])
         stamps = dict(stamp_list)
         reports: List[GoalReport] = []
+        goal_dispatches: List[int] = []
         total_moves = 0
-        for i, ((gid, _, _, _, _, dur), (vb, va, r, m)) in enumerate(zip(raw, fetched)):
+        for i, ((gid, _, _, _, _, dur, gd), (vb, va, r, m)) in enumerate(
+            zip(raw, fetched)
+        ):
             if not profile_goals and i in stamps and (i - 1) in stamps:
                 # true device-time bracket (enqueue time otherwise)
                 dur = stamps[i] - stamps[i - 1]
@@ -805,6 +846,7 @@ class GoalOptimizer:
                     duration_s=dur,
                 )
             )
+            goal_dispatches.append(gd)
             total_moves += int(m)
 
         names = G.GOAL_NAMES
@@ -832,4 +874,52 @@ class GoalOptimizer:
             num_dispatches=dispatches,
         )
         REGISTRY.timer(PROPOSAL_COMPUTATION_TIMER).update(result.duration_s)
+
+        # flight record: one span per goal (device-bracketed duration when the
+        # stamp mechanism works, enqueue wall otherwise) plus setup/finalize
+        # bookends; span dispatch counts sum to num_dispatches by construction
+        raw_wall = sum(t[5] for t in raw)
+        spans = [obs.Span("setup", "setup", setup_s, setup_dispatches)]
+        for rep, gd in zip(reports, goal_dispatches):
+            spans.append(
+                obs.Span(
+                    rep.name, "goal", rep.duration_s, gd,
+                    attrs={
+                        "violations_before": rep.violations_before,
+                        "violations_after": rep.violations_after,
+                        "moves": rep.moves_applied,
+                        "rounds": rep.rounds,
+                        "hard": rep.is_hard,
+                    },
+                )
+            )
+        spans.append(
+            obs.Span(
+                "finalize", "finalize",
+                max(result.duration_s - setup_s - raw_wall, 0.0),
+                dispatches - setup_dispatches - sum(goal_dispatches),
+            )
+        )
+        obs.finish_trace(
+            trace_token,
+            spans=spans,
+            attrs={
+                "num_goals": len(reports),
+                "num_dispatches": dispatches,
+                "total_moves": total_moves,
+                "violated_hard_goals": result.violated_hard_goals,
+                "residual_hard_violations": result.residual_hard_violations,
+                "residual_soft_violations": result.residual_soft_violations,
+                "balancedness": result.balancedness_score,
+                "provision_status": provision.status,
+                "fused_dispatch": fused,
+                "fast_mode": bool(ctx.fast_mode),
+                "stamps_supported": stamps_ok,
+                "num_brokers": state.num_brokers,
+                "num_partitions": state.num_partitions,
+                "num_replicas": state.num_replicas,
+                "movement": dataclasses.asdict(result.movement),
+                **obs.mesh_metadata(),
+            },
+        )
         return state, result

@@ -128,6 +128,36 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
     "PERMISSIONS": {"role": str},
     "BOOTSTRAP": {"samplesLoaded": int, "from": int, "to": int},
     "TRAIN": {"trained": bool},
+    "TRACES": {
+        "traces": [
+            {
+                "kind": str,
+                "trace_id": str,
+                "started_at": float,
+                "duration_s": float,
+                "platform": str,
+                "attrs": dict,
+                "spans": [
+                    {
+                        "name": str,
+                        "kind": str,
+                        "duration_s": float,
+                        "dispatches": int,
+                        "attrs": dict,
+                    }
+                ],
+                "compile_events": [dict],
+                "schema": int,
+            }
+        ],
+        "recorder": {
+            "size": int,
+            "capacity": int,
+            "dropped": int,
+            "by_kind": dict,
+            "jsonl_path": (str, None),
+        },
+    },
 }
 
 

@@ -4,7 +4,7 @@ Counterpart of the servlet/vertx front-ends (``servlet/CruiseControlEndPoint.jav
 lists the 22 endpoints; dispatch mirrors ``KafkaCruiseControlRequestHandler.doGetOrPost``):
 
 GET  STATE LOAD PARTITION_LOAD PROPOSALS KAFKA_CLUSTER_STATE USER_TASKS
-     REVIEW_BOARD PERMISSIONS BOOTSTRAP TRAIN
+     REVIEW_BOARD PERMISSIONS BOOTSTRAP TRAIN TRACES
 POST REBALANCE ADD_BROKER REMOVE_BROKER DEMOTE_BROKER FIX_OFFLINE_REPLICAS
      STOP_PROPOSAL_EXECUTION PAUSE_SAMPLING RESUME_SAMPLING TOPIC_CONFIGURATION
      RIGHTSIZE REMOVE_DISKS ADMIN REVIEW
@@ -44,6 +44,7 @@ API_PREFIX = "/kafkacruisecontrol/"
 GET_ENDPOINTS = {
     "STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE",
     "USER_TASKS", "REVIEW_BOARD", "PERMISSIONS", "BOOTSTRAP", "TRAIN",
+    "TRACES",
 }
 POST_ENDPOINTS = {
     "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
@@ -311,6 +312,19 @@ class CruiseControlApp:
         end = int(params.get("end", [str(int(time.time() * 1000))])[0])
         n = self.cc.monitor.bootstrap(start, end)
         return 200, {"samplesLoaded": n, "from": start, "to": end}
+
+    def get_traces(self, params) -> Tuple[int, dict]:
+        """Flight-recorder ring: newest-first solver/executor/detector traces
+        (``obs/recorder.py``) — the decision record behind every number the
+        STATE sensors aggregate."""
+        from cruise_control_tpu.obs import RECORDER
+
+        kind = params.get("kind", [None])[0]
+        limit = int(params.get("limit", ["50"])[0])
+        return 200, {
+            "traces": [t.to_dict() for t in RECORDER.recent(limit, kind=kind)],
+            "recorder": RECORDER.snapshot(),
+        }
 
     def get_train(self, params) -> Tuple[int, dict]:
         start = int(params.get("start", ["0"])[0])

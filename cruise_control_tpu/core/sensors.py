@@ -186,3 +186,5 @@ VALID_WINDOWS_GAUGE = "LoadMonitor.valid-windows"
 MONITORED_PARTITIONS_GAUGE = "LoadMonitor.monitored-partitions-percentage"
 EXECUTION_STARTED_COUNTER = "Executor.execution-started"
 EXECUTION_STOPPED_COUNTER = "Executor.execution-stopped"
+FLIGHT_TRACES_COUNTER = "FlightRecorder.traces-recorded"
+FLIGHT_RING_GAUGE = "FlightRecorder.ring-size"

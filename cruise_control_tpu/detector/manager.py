@@ -95,12 +95,29 @@ class AnomalyDetectorManager:
 
     def run_detector_once(self, detector: Detector) -> int:
         """One detection cycle (exposed for tests / synchronous drives)."""
+        from cruise_control_tpu.obs import recorder as obs
+
+        token = obs.start_trace("detector")
         try:
             anomalies = detector.run()
-        except Exception:
+        except Exception as e:
+            obs.finish_trace(
+                token,
+                attrs={"detector": type(detector).__name__, "error": str(e)},
+            )
             return 0
         for a in anomalies:
             self._enqueue(a)
+        obs.finish_trace(
+            token,
+            attrs={
+                "detector": type(detector).__name__,
+                "anomalies": len(anomalies),
+                "anomaly_types": sorted(
+                    {a.anomaly_type.name for a in anomalies}
+                ),
+            },
+        )
         return len(anomalies)
 
     def _enqueue(self, anomaly: Anomaly) -> None:
@@ -156,6 +173,32 @@ class AnomalyDetectorManager:
 
         Returns the action taken ("IGNORE" | "CHECK" | "FIXED" | "FIX_FAILED").
         """
+        from cruise_control_tpu.obs import recorder as obs
+
+        token = obs.start_trace("anomaly")
+        try:
+            action = self._handle_anomaly(anomaly)
+        except Exception as e:
+            obs.finish_trace(
+                token,
+                attrs={
+                    "anomaly_type": anomaly.anomaly_type.name,
+                    "anomaly_id": anomaly.anomaly_id,
+                    "error": str(e),
+                },
+            )
+            raise
+        obs.finish_trace(
+            token,
+            attrs={
+                "anomaly_type": anomaly.anomaly_type.name,
+                "anomaly_id": anomaly.anomaly_id,
+                "action": action,
+            },
+        )
+        return action
+
+    def _handle_anomaly(self, anomaly: Anomaly) -> str:
         result = self.notifier.on_anomaly(anomaly)
         if result.action is NotificationAction.IGNORE:
             return "IGNORE"

@@ -159,7 +159,10 @@ class Executor:
             PROPOSAL_EXECUTION_TIMER,
             REGISTRY,
         )
+        from cruise_control_tpu.obs import recorder as obs
 
+        trace_token = obs.start_trace("execution")
+        phase_spans = []
         t0 = time.monotonic()
         REGISTRY.counter(EXECUTION_STARTED_COUNTER).inc()
         throttle = ReplicationThrottleHelper(self.backend, self.throttle_rate_bytes)
@@ -167,9 +170,22 @@ class Executor:
             # pause partition sampling while replicas move (:1414)
             self._pause_sampling("executor: inter-broker replica movement")
         try:
-            self._inter_broker_phase(planner, throttle)
-            self._intra_broker_phase(planner)
-            self._leadership_phase(planner)
+            for name, tasks, phase in (
+                ("inter_broker", planner.inter_broker,
+                 lambda: self._inter_broker_phase(planner, throttle)),
+                ("intra_broker", planner.intra_broker,
+                 lambda: self._intra_broker_phase(planner)),
+                ("leadership", planner.leadership,
+                 lambda: self._leadership_phase(planner)),
+            ):
+                p0 = time.monotonic()
+                phase()
+                phase_spans.append(
+                    obs.Span(
+                        name, "phase", time.monotonic() - p0,
+                        attrs={"tasks": len(tasks)},
+                    )
+                )
         finally:
             throttle.clear_throttles()
             if self._resume_sampling and planner.inter_broker:
@@ -187,6 +203,17 @@ class Executor:
             )
             REGISTRY.timer(PROPOSAL_EXECUTION_TIMER).update(self._last_summary.duration_s)
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            obs.finish_trace(
+                trace_token,
+                spans=phase_spans,
+                attrs={
+                    "execution_id": execution_id,
+                    "stopped": self._last_summary.stopped,
+                    "completed": self._last_summary.completed,
+                    "dead": self._last_summary.dead,
+                    "aborted": self._last_summary.aborted,
+                },
+            )
             self.notifier.on_execution_finished(self._last_summary)
 
     def _now_ms(self) -> int:

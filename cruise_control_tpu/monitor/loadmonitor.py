@@ -244,10 +244,26 @@ class LoadMonitor:
             CLUSTER_MODEL_CREATION_TIMER,
             REGISTRY,
         )
+        from cruise_control_tpu.obs import recorder as obs
 
-        with self.acquire_for_model_generation():
-            with REGISTRY.timer(CLUSTER_MODEL_CREATION_TIMER).time():
-                return self._cluster_model_locked(from_ms, to_ms, requirements)
+        token = obs.start_trace("model")
+        try:
+            with self.acquire_for_model_generation():
+                with REGISTRY.timer(CLUSTER_MODEL_CREATION_TIMER).time():
+                    model = self._cluster_model_locked(from_ms, to_ms, requirements)
+        except Exception as e:
+            # a failed build (e.g. not enough valid windows during warm-up) is
+            # exactly the kind of run that must leave a flight record
+            obs.finish_trace(token, attrs={"error": str(e)})
+            raise
+        obs.finish_trace(
+            token,
+            attrs={
+                "num_brokers": len(model.brokers()),
+                "num_partitions": len(model.partitions()),
+            },
+        )
+        return model
 
     def _cluster_model_locked(
         self,

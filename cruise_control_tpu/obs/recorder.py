@@ -1,0 +1,322 @@
+"""Flight recorder: structured traces of what the solver actually did.
+
+The reference records per-goal optimization durations and surfaces them
+through ``OptimizerResult.java`` and JMX sensors; what it never has is a
+*replayable decision record*.  Here every ``optimize()`` / executor run /
+detector cycle / cluster-model build emits a :class:`TraceRecord` — per-goal
+:class:`Span`\\ s carrying wall (and, when the host-callback stamp mechanism
+works, device-bracketed) time, per-goal dispatch counts, violations
+before/after, and moves — plus JAX compile events and platform/mesh metadata.
+
+Records land in an in-memory ring buffer (served by the ``TRACES`` REST
+endpoint) and, when configured, an append-only JSONL sink
+(``CC_TPU_FLIGHT_JSONL`` or :meth:`FlightRecorder.configure`), so a regressed
+run leaves a diffable artifact instead of a shrug.  Counters/timers are
+registered in the process-wide :class:`SensorRegistry` (``core/sensors.py``)
+under the ``FlightRecorder.*`` family.
+
+The recorder is pure host-side bookkeeping: nothing here touches the device
+or adds dispatches — span dispatch counts are accounted by the emitting
+subsystem (``analyzer/optimizer.py`` tracks its own enqueue counter) and the
+invariant *sum of span dispatches == OptimizerResult.num_dispatches* is
+asserted by ``tests/test_obs.py`` and checked by the regression gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: bump when the JSONL record shape changes incompatibly
+SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass
+class Span:
+    """One timed unit of work inside a trace (a goal, a phase, a fetch)."""
+
+    name: str
+    kind: str                 # "goal" | "setup" | "finalize" | "phase" | ...
+    duration_s: float
+    #: jitted-computation dispatches enqueued during this span (0 for host-only
+    #: spans); per-trace these sum to the emitter's reported dispatch total
+    dispatches: int = 0
+    attrs: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Span":
+        return cls(
+            name=d["name"], kind=d["kind"], duration_s=d["duration_s"],
+            dispatches=d.get("dispatches", 0), attrs=dict(d.get("attrs", {})),
+        )
+
+
+@dataclasses.dataclass
+class TraceRecord:
+    """One recorded operation: an optimize, an execution, a detector cycle…"""
+
+    kind: str                 # "optimize" | "execution" | "detector" | "model"
+    trace_id: str
+    started_at: float         # epoch seconds
+    duration_s: float
+    platform: str             # jax.default_backend() at record time
+    attrs: Dict[str, object] = dataclasses.field(default_factory=dict)
+    spans: List[Span] = dataclasses.field(default_factory=list)
+    #: JAX compile/lowering events that fired during the operation
+    #: ([{"event": name, "duration_s": secs}]); empty when jax.monitoring
+    #: listeners are unavailable
+    compile_events: List[dict] = dataclasses.field(default_factory=list)
+    schema: int = SCHEMA_VERSION
+
+    @property
+    def total_dispatches(self) -> int:
+        return sum(s.dispatches for s in self.spans)
+
+    @property
+    def compile_s(self) -> float:
+        return sum(e.get("duration_s", 0.0) for e in self.compile_events)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["spans"] = [s.to_dict() for s in self.spans]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceRecord":
+        return cls(
+            kind=d["kind"],
+            trace_id=d["trace_id"],
+            started_at=d["started_at"],
+            duration_s=d["duration_s"],
+            platform=d.get("platform", "unknown"),
+            attrs=dict(d.get("attrs", {})),
+            spans=[Span.from_dict(s) for s in d.get("spans", [])],
+            compile_events=list(d.get("compile_events", [])),
+            schema=d.get("schema", SCHEMA_VERSION),
+        )
+
+
+# -- JAX compile-event capture ------------------------------------------------------
+#
+# jax.monitoring broadcasts named duration events from the compile pipeline
+# ("/jax/core/compile/backend_compile_duration" & co).  One process-wide
+# listener appends to a monotonic log; emitters snapshot an index before the
+# operation (``compile_mark``) and collect the delta after
+# (``compile_events_since``), so each trace carries exactly the compiles it
+# caused (single-threaded emitters; concurrent optimizes may cross-attribute,
+# which is acceptable for a diagnostic record).
+
+_COMPILE_LOG: List[dict] = []
+#: total events trimmed off the front of the log — marks are absolute event
+#: counts, so outstanding tokens stay valid across trims
+_COMPILE_BASE = 0
+_COMPILE_LOCK = threading.Lock()
+_LISTENER_INSTALLED = False
+_COMPILE_LOG_CAP = 4096
+
+
+def _install_compile_listener() -> None:
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    _LISTENER_INSTALLED = True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if "compile" not in event and "lower" not in event:
+                return
+            global _COMPILE_BASE
+            with _COMPILE_LOCK:
+                _COMPILE_LOG.append(
+                    {"event": event, "duration_s": float(duration)}
+                )
+                drop = len(_COMPILE_LOG) - _COMPILE_LOG_CAP
+                if drop > 0:
+                    del _COMPILE_LOG[:drop]
+                    _COMPILE_BASE += drop
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        # no monitoring API in this jax build — traces carry no compile events
+        pass
+
+
+def compile_mark() -> int:
+    """Absolute compile-event count; pair with :func:`compile_events_since`.
+    Absolute (not a list index) so a token outlives ring trims."""
+    _install_compile_listener()
+    with _COMPILE_LOCK:
+        return _COMPILE_BASE + len(_COMPILE_LOG)
+
+
+def compile_events_since(mark: int) -> List[dict]:
+    with _COMPILE_LOCK:
+        return list(_COMPILE_LOG[max(mark - _COMPILE_BASE, 0):])
+
+
+# -- the recorder -------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Ring buffer + optional JSONL sink for :class:`TraceRecord`\\ s."""
+
+    def __init__(
+        self, capacity: int = 256, jsonl_path: Optional[str] = None
+    ) -> None:
+        self.capacity = capacity
+        self.jsonl_path = jsonl_path
+        self._lock = threading.Lock()
+        self._ring: List[TraceRecord] = []
+        self._ids = itertools.count(1)
+        self._dropped = 0
+
+    def configure(self, jsonl_path: Optional[str]) -> None:
+        """Point (or disable, with None) the append-only JSONL sink."""
+        with self._lock:
+            self.jsonl_path = jsonl_path
+
+    def next_trace_id(self, kind: str) -> str:
+        return f"{kind}-{next(self._ids)}-{os.getpid()}"
+
+    def record(self, trace: TraceRecord) -> TraceRecord:
+        """Append to the ring, the JSONL sink, and the sensor registry."""
+        from cruise_control_tpu.core.sensors import (
+            FLIGHT_RING_GAUGE,
+            FLIGHT_TRACES_COUNTER,
+            REGISTRY,
+        )
+
+        with self._lock:
+            self._ring.append(trace)
+            if len(self._ring) > self.capacity:
+                del self._ring[: len(self._ring) - self.capacity]
+                self._dropped += 1
+            path = self.jsonl_path
+            size = len(self._ring)
+        if path:
+            line = json.dumps(trace.to_dict(), default=str)
+            try:
+                with open(path, "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                # a full/readonly disk must never take down the solver
+                pass
+        REGISTRY.counter(FLIGHT_TRACES_COUNTER).inc()
+        REGISTRY.gauge(FLIGHT_RING_GAUGE).set(size)
+        REGISTRY.timer(f"FlightRecorder.{trace.kind}-duration").update(
+            trace.duration_s
+        )
+        return trace
+
+    def recent(
+        self, limit: int = 50, kind: Optional[str] = None
+    ) -> List[TraceRecord]:
+        """Newest-first slice of the ring, optionally filtered by kind."""
+        with self._lock:
+            items = list(reversed(self._ring))
+        if kind is not None:
+            items = [t for t in items if t.kind == kind]
+        return items[: max(limit, 0)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._dropped = 0
+
+    def snapshot(self) -> dict:
+        """Summary for the STATE sensor surface."""
+        with self._lock:
+            kinds: Dict[str, int] = {}
+            for t in self._ring:
+                kinds[t.kind] = kinds.get(t.kind, 0) + 1
+            return {
+                "size": len(self._ring),
+                "capacity": self.capacity,
+                "dropped": self._dropped,
+                "by_kind": kinds,
+                "jsonl_path": self.jsonl_path,
+            }
+
+
+def read_jsonl(path: str) -> List[TraceRecord]:
+    """Load an append-only sink back into records (blank lines skipped)."""
+    out: List[TraceRecord] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(TraceRecord.from_dict(json.loads(line)))
+    return out
+
+
+def _platform() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "unknown"
+
+
+def mesh_metadata() -> dict:
+    """Platform/mesh facts attached to solver traces."""
+    try:
+        import jax
+
+        return {
+            "platform": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "process_count": getattr(jax, "process_count", lambda: 1)(),
+        }
+    except Exception:
+        return {"platform": "unknown", "device_count": 0, "process_count": 1}
+
+
+def start_trace(kind: str) -> dict:
+    """Begin-of-operation token: id, wall-clock anchors, compile-log mark."""
+    return {
+        "kind": kind,
+        "trace_id": RECORDER.next_trace_id(kind),
+        "started_at": time.time(),
+        "t0": time.monotonic(),
+        "compile_mark": compile_mark(),
+    }
+
+
+def finish_trace(
+    token: dict,
+    attrs: Optional[dict] = None,
+    spans: Optional[List[Span]] = None,
+) -> Optional[TraceRecord]:
+    """Close a :func:`start_trace` token and record it.  Never raises —
+    observability must not break the operation it observes — so emitting
+    call sites (optimizer, executor, detector, monitor) need no guard."""
+    try:
+        return RECORDER.record(
+            TraceRecord(
+                kind=token["kind"],
+                trace_id=token["trace_id"],
+                started_at=token["started_at"],
+                duration_s=time.monotonic() - token["t0"],
+                platform=_platform(),
+                attrs=attrs or {},
+                spans=spans or [],
+                compile_events=compile_events_since(token["compile_mark"]),
+            )
+        )
+    except Exception:
+        return None
+
+
+#: process-wide default recorder (the flight-data singleton every subsystem
+#: emits into); CC_TPU_FLIGHT_JSONL points the persistent sink
+RECORDER = FlightRecorder(jsonl_path=os.environ.get("CC_TPU_FLIGHT_JSONL"))

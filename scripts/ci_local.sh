@@ -15,6 +15,9 @@ python -m compileall -q cruise_control_tpu tests scripts bench.py bench_scale.py
 echo "== fast tier =="
 python -m pytest tests/ -x -q -m "not slow"
 
+echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check) =="
+python scripts/bench_gate.py
+
 if [[ "${1:-}" == "--slow" ]]; then
   echo "== slow tier =="
   python -m pytest tests/ -q -m slow

@@ -1,0 +1,565 @@
+"""Observability subsystem tests: flight recorder + regression gate.
+
+Covers the ISSUE-1 acceptance criteria: a full ``optimize()`` on the
+deterministic fixture emits a trace whose per-goal spans sum to the reported
+``num_dispatches``; the JSONL sink round-trips; the gate passes on its own
+committed numbers and fails on a synthetic slowdown / hard-violation increase
+/ inflated baseline.  Plus the satellite regression tests that guard the
+numbers the gate compares (movement-stats leadership accounting, radix-kernel
+dispatch gating).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.obs import gate as gate_mod
+from cruise_control_tpu.obs.gate import (
+    GateThresholds,
+    compare,
+    compare_bench,
+    latest_bench_baseline,
+    run_tier,
+    write_gate_baseline,
+)
+from cruise_control_tpu.obs.recorder import (
+    RECORDER,
+    FlightRecorder,
+    Span,
+    TraceRecord,
+    read_jsonl,
+)
+
+
+# -- flight recorder ---------------------------------------------------------------
+
+
+def _sample_trace(kind="optimize", n_spans=3):
+    return TraceRecord(
+        kind=kind,
+        trace_id=f"{kind}-test-1",
+        started_at=1_700_000_000.0,
+        duration_s=1.5,
+        platform="cpu",
+        attrs={"num_dispatches": n_spans, "balancedness": 98.5},
+        spans=[
+            Span(f"goal{i}", "goal", 0.5, 1, attrs={"moves": i})
+            for i in range(n_spans)
+        ],
+        compile_events=[{"event": "/jax/core/compile/x", "duration_s": 0.25}],
+    )
+
+
+class TestRecorder:
+    def test_ring_capacity_and_recent_order(self):
+        rec = FlightRecorder(capacity=3)
+        for i in range(5):
+            t = _sample_trace()
+            t.trace_id = f"t{i}"
+            rec.record(t)
+        recent = rec.recent(10)
+        assert [t.trace_id for t in recent] == ["t4", "t3", "t2"]
+        assert rec.snapshot()["size"] == 3
+        assert rec.snapshot()["dropped"] == 2
+
+    def test_kind_filter(self):
+        rec = FlightRecorder()
+        rec.record(_sample_trace(kind="optimize"))
+        rec.record(_sample_trace(kind="execution"))
+        assert [t.kind for t in rec.recent(10, kind="execution")] == ["execution"]
+
+    def test_jsonl_round_trip(self, tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        rec = FlightRecorder(jsonl_path=path)
+        orig = _sample_trace()
+        rec.record(orig)
+        rec.record(_sample_trace(kind="execution", n_spans=1))
+        loaded = read_jsonl(path)
+        assert len(loaded) == 2
+        assert loaded[0].to_dict() == orig.to_dict()
+        assert loaded[0].total_dispatches == orig.total_dispatches
+        assert loaded[0].compile_s == pytest.approx(0.25)
+
+    def test_sink_append_only(self, tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        rec = FlightRecorder(jsonl_path=path)
+        rec.record(_sample_trace())
+        first = open(path).read()
+        rec.record(_sample_trace(kind="detector"))
+        assert open(path).read().startswith(first)  # earlier records untouched
+
+    def test_compile_marks_survive_log_trim(self, monkeypatch):
+        # marks are absolute event counts: trimming the front of the compile
+        # log must not shift an outstanding token's window (a long-lived
+        # server crosses the cap after ~10 cold optimizes)
+        from cruise_control_tpu.obs import recorder as r
+
+        from jax import monitoring
+
+        r._install_compile_listener()  # the real listener, driven for real
+        monkeypatch.setattr(r, "_COMPILE_LOG", [])
+        monkeypatch.setattr(r, "_COMPILE_BASE", 0)
+        monkeypatch.setattr(r, "_COMPILE_LOG_CAP", 4)
+
+        def emit(name):
+            monitoring.record_event_duration_secs(f"/test/compile/{name}", 0.1)
+
+        for i in range(3):
+            emit(f"pre{i}")
+        mark = r.compile_mark()
+        for i in range(6):  # crosses the cap: pre* and early mine* trimmed
+            emit(f"mine{i}")
+        events = [e["event"].rsplit("/", 1)[-1] for e in r.compile_events_since(mark)]
+        assert events == ["mine2", "mine3", "mine4", "mine5"]
+        assert "pre2" not in events  # a stale index would have included it
+
+    def test_finish_trace_never_raises(self, monkeypatch):
+        from cruise_control_tpu.obs import recorder as r
+
+        token = r.start_trace("optimize")
+        monkeypatch.setattr(
+            r.RECORDER, "record",
+            lambda trace: (_ for _ in ()).throw(RuntimeError("sink down")),
+        )
+        assert r.finish_trace(token, attrs={"x": 1}) is None
+
+    def test_sensors_registered(self):
+        from cruise_control_tpu.core.sensors import (
+            FLIGHT_RING_GAUGE,
+            FLIGHT_TRACES_COUNTER,
+            REGISTRY,
+        )
+
+        rec = FlightRecorder()
+        before = REGISTRY.counter(FLIGHT_TRACES_COUNTER).snapshot()
+        rec.record(_sample_trace())
+        assert REGISTRY.counter(FLIGHT_TRACES_COUNTER).snapshot() == before + 1
+        assert REGISTRY.gauge(FLIGHT_RING_GAUGE).snapshot() >= 1
+
+
+class TestOptimizeTrace:
+    """ISSUE-1 acceptance: spans of a full optimize() account for every
+    dispatch, on the deterministic fixture, through the JSONL sink."""
+
+    @pytest.fixture(scope="class")
+    def traced_run(self, tmp_path_factory):
+        from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
+        from cruise_control_tpu.analyzer import goals_base as G
+        from tests.fixtures import service_test_goals, unbalanced2
+
+        path = str(tmp_path_factory.mktemp("obs") / "flight.jsonl")
+        old_path = RECORDER.jsonl_path
+        RECORDER.configure(path)
+        try:
+            state, maps = unbalanced2().to_arrays()
+            ctx = GoalContext.build(state.num_topics, state.num_brokers)
+            goals = service_test_goals()
+            opt = GoalOptimizer(
+                goal_ids=goals,
+                hard_ids=tuple(g for g in goals if g in G.HARD_GOALS),
+                enable_heavy_goals=False,
+            )
+            final, result = opt.optimize(state, ctx, maps=maps)
+        finally:
+            RECORDER.configure(old_path)
+        return result, path, len(goals)
+
+    def test_goal_spans_match_goal_list(self, traced_run):
+        result, path, n_goals = traced_run
+        trace = read_jsonl(path)[-1]
+        goal_spans = [s for s in trace.spans if s.kind == "goal"]
+        assert len(goal_spans) == n_goals == len(result.goal_reports)
+        assert [s.name for s in goal_spans] == [
+            r.name for r in result.goal_reports
+        ]
+
+    def test_span_dispatches_sum_to_num_dispatches(self, traced_run):
+        result, path, _ = traced_run
+        trace = read_jsonl(path)[-1]
+        assert trace.total_dispatches == result.num_dispatches
+        assert trace.attrs["num_dispatches"] == result.num_dispatches
+
+    def test_span_attrs_mirror_goal_reports(self, traced_run):
+        result, path, _ = traced_run
+        trace = read_jsonl(path)[-1]
+        goal_spans = [s for s in trace.spans if s.kind == "goal"]
+        for span, rep in zip(goal_spans, result.goal_reports):
+            assert span.attrs["moves"] == rep.moves_applied
+            assert span.attrs["violations_after"] == rep.violations_after
+
+    def test_trace_metadata(self, traced_run):
+        result, path, _ = traced_run
+        trace = read_jsonl(path)[-1]
+        assert trace.kind == "optimize"
+        assert trace.platform == "cpu"
+        assert trace.attrs["device_count"] >= 1
+        assert trace.attrs["balancedness"] == pytest.approx(
+            result.balancedness_score
+        )
+
+    def test_aborted_optimize_keeps_dispatch_invariant(self):
+        """An OptimizationFailure run still records a trace, with the refusing
+        goal as an 'aborted' span so span dispatches sum to num_dispatches."""
+        import jax.numpy as jnp
+
+        from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
+        from cruise_control_tpu.analyzer import goals_base as G
+        from cruise_control_tpu.analyzer.optimizer import OptimizationFailure
+        from tests.test_kafka_assigner import _piled_but_rack_aware
+
+        state, _ = _piled_but_rack_aware()
+        alive = np.asarray(state.broker_alive).copy()
+        alive[2:] = False
+        state = state.replace(broker_alive=jnp.asarray(alive))
+        ctx = GoalContext.build(
+            state.num_topics, state.num_brokers,
+            excluded_brokers_for_replica_move=(1,),
+        )
+        opt = GoalOptimizer(
+            goal_ids=(G.KAFKA_ASSIGNER_RACK,),
+            hard_ids=(G.KAFKA_ASSIGNER_RACK,),
+        )
+        RECORDER.clear()
+        with pytest.raises(OptimizationFailure):
+            opt.optimize(state, ctx, raise_on_hard_failure=True)
+        trace = RECORDER.recent(1, kind="optimize")[0]
+        assert "error" in trace.attrs
+        aborted = [s for s in trace.spans if s.kind == "aborted"]
+        assert [s.name for s in aborted] == [
+            G.GOAL_NAMES[G.KAFKA_ASSIGNER_RACK]
+        ]
+        assert trace.total_dispatches == trace.attrs["num_dispatches"]
+
+
+class TestSubsystemTraces:
+    def test_executor_trace(self):
+        from cruise_control_tpu.backend import FakeClusterBackend
+        from cruise_control_tpu.executor import Executor
+        from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+
+        backend = FakeClusterBackend()
+        for b in range(3):
+            backend.add_broker(b, rack=str(b))
+        backend.create_partition(("T", 0), [0, 1], load=[1.0, 1.0, 1.0, 1.0])
+        RECORDER.clear()
+        ex = Executor(backend)
+        summary = ex.execute_proposals(
+            [
+                ExecutionProposal(
+                    tp=("T", 0), partition_size=1.0, old_leader=0,
+                    old_replicas=(0, 1), new_replicas=(0, 2),
+                )
+            ]
+        )
+        traces = RECORDER.recent(5, kind="execution")
+        assert traces, "executor emitted no flight record"
+        t = traces[0]
+        assert t.attrs["completed"] == summary.completed
+        assert {s.name for s in t.spans} == {
+            "inter_broker", "intra_broker", "leadership",
+        }
+
+    def test_detector_trace(self):
+        from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+        from cruise_control_tpu.detector.notifier import AnomalyNotifier
+
+        class NullDetector:
+            def run(self):
+                return []
+
+        RECORDER.clear()
+        mgr = AnomalyDetectorManager(
+            cruise_control=None, notifier=AnomalyNotifier(), detectors=[]
+        )
+        assert mgr.run_detector_once(NullDetector()) == 0
+        traces = RECORDER.recent(5, kind="detector")
+        assert traces and traces[0].attrs["detector"] == "NullDetector"
+        assert traces[0].attrs["anomalies"] == 0
+
+    def test_traces_endpoint(self):
+        from cruise_control_tpu.api.schemas import validate_endpoint
+        from cruise_control_tpu.api.server import CruiseControlApp
+
+        RECORDER.clear()
+        RECORDER.record(_sample_trace())
+        app = CruiseControlApp.__new__(CruiseControlApp)  # handler needs no wiring
+        status, body = app.get_traces({"limit": ["10"]})
+        assert status == 200
+        assert body["traces"][0]["kind"] == "optimize"
+        validate_endpoint("TRACES", body)
+        # kind filter
+        status, body = app.get_traces({"kind": ["execution"]})
+        assert body["traces"] == []
+
+
+# -- regression gate ---------------------------------------------------------------
+
+
+BASE = {
+    "tier": "config2_small",
+    "wall_s": 1.0,
+    "num_dispatches": 20,
+    "residual_hard_violations": 0.0,
+    "balancedness": 86.9,
+}
+
+
+def _measured(**over):
+    m = {
+        "tier": "config2_small",
+        "wall_s": 1.0,
+        "num_dispatches": 20,
+        "span_dispatch_sum": 20,
+        "residual_hard_violations": 0.0,
+        "balancedness": 86.9,
+    }
+    m.update(over)
+    return m
+
+
+class TestGateCompare:
+    def test_pass_on_baseline_numbers(self):
+        assert compare(BASE, _measured()) == []
+
+    def test_fail_on_2x_wall(self):
+        fails = compare(BASE, _measured(wall_s=2.0))
+        assert any("wall" in f for f in fails)
+
+    def test_wall_within_threshold_passes(self):
+        assert compare(BASE, _measured(wall_s=1.2)) == []
+
+    def test_wall_floor_absorbs_tiny_noise(self):
+        # a 3 ms tier "doubling" to 60 ms is scheduler noise, not a regression
+        base = dict(BASE, wall_s=0.03)
+        assert compare(base, _measured(wall_s=0.06)) == []
+
+    def test_fail_on_any_hard_violation_increase(self):
+        fails = compare(BASE, _measured(residual_hard_violations=1.0))
+        assert any("hard violations" in f for f in fails)
+
+    def test_fail_on_dispatch_increase(self):
+        fails = compare(BASE, _measured(num_dispatches=21))
+        assert any("dispatches" in f for f in fails)
+
+    def test_fail_on_balancedness_drop(self):
+        fails = compare(BASE, _measured(balancedness=84.0))
+        assert any("balancedness" in f for f in fails)
+
+    def test_fail_on_recorder_drift(self):
+        fails = compare(BASE, _measured(span_dispatch_sum=15))
+        assert any("recorder drift" in f for f in fails)
+
+    def test_wall_slack_loosens_only_wall(self):
+        m = _measured(wall_s=2.0, residual_hard_violations=1.0)
+        fails = compare(BASE, m, wall_slack=3.0)
+        assert not any("wall" in f and "exceeds" in f for f in fails)
+        assert any("hard violations" in f for f in fails)
+
+    def test_bench_cross_check(self):
+        bench = {"residual_hard_violations": 0, "num_dispatches": 19}
+        assert compare_bench(bench, _measured()) == []  # 20 <= 19 + slack(2)
+        fails = compare_bench(bench, _measured(num_dispatches=25))
+        assert any("dispatches" in f for f in fails)
+        fails = compare_bench(bench, _measured(residual_hard_violations=2.0))
+        assert any("hard violations" in f for f in fails)
+
+    def test_latest_bench_baseline_picks_max_round(self, tmp_path):
+        for n, disp in ((3, 17), (4, 19)):
+            (tmp_path / f"BENCH_r0{n}.json").write_text(
+                json.dumps({"n": n, "parsed": {"num_dispatches": disp}})
+            )
+        assert latest_bench_baseline(str(tmp_path))["num_dispatches"] == 19
+        assert latest_bench_baseline(str(tmp_path / "empty")) is None
+
+
+class TestGateEndToEnd:
+    """Drive the real CLI (main) against a real measured tier.
+
+    The smoke tier compiles once per test session (~10 s); subsequent
+    in-process runs reuse jax's compile cache, so the three gate invocations
+    stay cheap.  Acceptance: exit 0 on committed numbers, exit 1 on a
+    synthetic 2× slowdown and on any hard-violation increase.
+    """
+
+    @pytest.fixture(scope="class")
+    def smoke_baseline(self, tmp_path_factory):
+        path = tmp_path_factory.mktemp("gate") / "GATE_BASELINE_cpu.json"
+        m = run_tier("smoke")
+        assert "error" not in m
+        write_gate_baseline(str(path), [m])
+        return str(path), m
+
+    def test_exit_zero_on_committed_numbers(self, smoke_baseline):
+        path, _ = smoke_baseline
+        rc = gate_mod.main(
+            ["--tiers", "smoke", "--baseline", path, "--in-process",
+             "--bench-baseline", "none"]
+        )
+        assert rc == 0
+
+    def test_exit_nonzero_on_synthetic_slowdown(self, smoke_baseline):
+        path, m = smoke_baseline
+        # sleep ≥ the whole wall allowance: an unambiguous 2×+ slowdown
+        inject = m["wall_s"] * 1.25 + 0.5
+        rc = gate_mod.main(
+            ["--tiers", "smoke", "--baseline", path, "--in-process",
+             "--bench-baseline", "none", "--inject-sleep", str(inject)]
+        )
+        assert rc == 1
+
+    def test_exit_nonzero_on_hard_violation_increase(
+        self, smoke_baseline, tmp_path
+    ):
+        path, m = smoke_baseline
+        doc = json.load(open(path))
+        # a tampered baseline claiming a run with NEGATIVE residual hard
+        # violations: any real measurement is an increase → must fail
+        doc["tiers"]["smoke"]["residual_hard_violations"] = -1.0
+        tampered = tmp_path / "tampered.json"
+        tampered.write_text(json.dumps(doc))
+        rc = gate_mod.main(
+            ["--tiers", "smoke", "--baseline", str(tampered), "--in-process",
+             "--bench-baseline", "none"]
+        )
+        assert rc == 1
+
+    def test_update_baseline_subset_preserves_other_tiers(
+        self, smoke_baseline, tmp_path
+    ):
+        """A --tiers subset refresh merges into the doc instead of discarding
+        the committed baselines of the tiers it didn't run."""
+        _, m = smoke_baseline
+        path = tmp_path / "merged.json"
+        write_gate_baseline(str(path), [dict(m, tier="config1")])
+        write_gate_baseline(str(path), [dict(m, wall_s=9.9)])  # smoke only
+        doc = json.load(open(path))
+        assert set(doc["tiers"]) == {"config1", "smoke"}
+        assert doc["tiers"]["smoke"]["wall_s"] == 9.9
+
+    def test_exit_two_on_missing_baseline(self, tmp_path):
+        rc = gate_mod.main(
+            ["--tiers", "smoke", "--baseline", str(tmp_path / "nope.json"),
+             "--in-process", "--bench-baseline", "none"]
+        )
+        assert rc == 2
+
+    def test_exit_two_on_unknown_tier(self):
+        assert gate_mod.main(["--tiers", "warp9"]) == 2
+
+    def test_committed_baseline_has_default_tiers(self):
+        """The repo must ship a baseline covering every default tier — a gate
+        that can't find its baseline is a gate that never fires."""
+        import os
+
+        path = os.path.join(gate_mod._repo_root(), gate_mod.DEFAULT_BASELINE)
+        doc = json.load(open(path))
+        assert doc["schema"] == gate_mod.GATE_SCHEMA
+        for tier in gate_mod.DEFAULT_TIERS:
+            assert tier in doc["tiers"], f"no committed baseline for {tier}"
+            assert doc["tiers"][tier]["residual_hard_violations"] == 0.0
+
+
+# -- satellite regressions ----------------------------------------------------------
+
+
+class TestMovementStatsLeaderless:
+    """ADVICE.md (medium): leaderless/padded partitions carry
+    ``partition_leader == -1``; numpy ``-1`` indexing wraps to the LAST
+    replica row, so every such partition used to phantom-count as a
+    leadership move whenever that last replica changed brokers.  The gate
+    compares movement numbers — they must not lie."""
+
+    def _two_partition_state(self):
+        import jax.numpy as jnp
+
+        from cruise_control_tpu.model.arrays import ClusterArrays
+
+        # partition 0: leader = replica 0; partition 1: LEADERLESS (-1).
+        # replica layout: [p0-leader, p0-follower, p1-replica(last row)]
+        def build(replica_broker):
+            return ClusterArrays(
+                replica_partition=jnp.asarray([0, 0, 1], jnp.int32),
+                replica_broker=jnp.asarray(replica_broker, jnp.int32),
+                replica_disk=jnp.full(3, -1, jnp.int32),
+                replica_valid=jnp.ones(3, bool),
+                base_load=jnp.ones((3, 4), jnp.float32),
+                original_broker=jnp.asarray(replica_broker, jnp.int32),
+                partition_topic=jnp.zeros(2, jnp.int32),
+                partition_leader=jnp.asarray([0, -1], jnp.int32),
+                leadership_delta=jnp.zeros((2, 4), jnp.float32),
+                broker_rack=jnp.zeros(3, jnp.int32),
+                broker_host=jnp.zeros(3, jnp.int32),
+                broker_capacity=jnp.ones((3, 4), jnp.float32),
+                broker_alive=jnp.ones(3, bool),
+                broker_new=jnp.zeros(3, bool),
+                broker_demoted=jnp.zeros(3, bool),
+                disk_broker=jnp.zeros(0, jnp.int32),
+                disk_capacity=jnp.zeros(0, jnp.float32),
+                disk_alive=jnp.zeros(0, bool),
+                num_racks=1, num_topics=1, num_hosts=1,
+            )
+
+        return build
+
+    def test_leaderless_partition_not_counted_when_last_replica_moves(self):
+        from cruise_control_tpu.analyzer.optimizer import movement_stats
+
+        build = self._two_partition_state()
+        initial = build([0, 1, 2])
+        final = build([0, 1, 0])     # ONLY the last row (p1's replica) moved
+        m = movement_stats(initial, final)
+        assert m.num_inter_broker_moves == 1
+        # before the (l0>=0)&(l1>=0) mask, p1's -1 leader wrapped to row 2
+        # and this counted as a leadership move
+        assert m.num_leadership_moves == 0
+
+    def test_real_leader_move_still_counted(self):
+        from cruise_control_tpu.analyzer.optimizer import movement_stats
+
+        build = self._two_partition_state()
+        m = movement_stats(build([0, 1, 2]), build([2, 1, 2]))
+        assert m.num_leadership_moves == 1
+
+
+class TestRadixDispatchGating:
+    """ADVICE.md (medium): the radix kernel (2048 < B ≤ 16384) has never been
+    compiled on a chip — it must NOT own the TPU hot path until a committed
+    on-chip A/B exists.  ``CC_TPU_PALLAS_SEGMENTS=radix`` (or force) opts in."""
+
+    def test_default_keeps_xla_scatter_above_2048_segments(self, monkeypatch):
+        from cruise_control_tpu.ops import segments
+
+        monkeypatch.delenv("CC_TPU_PALLAS_SEGMENTS", raising=False)
+        monkeypatch.setattr(segments, "_tpu_backend", lambda: True)
+        # flat kernel's range: still dispatches to Pallas on TPU
+        assert segments._use_pallas(100_000, 1024) is True
+        # radix range: gated OFF by default even on TPU
+        assert segments._use_pallas(100_000, 4096) is False
+        # beyond the radix ceiling: always XLA
+        assert segments._use_pallas(100_000, 32_768) is False
+
+    def test_radix_flag_opts_in(self, monkeypatch):
+        from cruise_control_tpu.ops import segments
+
+        monkeypatch.setenv("CC_TPU_PALLAS_SEGMENTS", "radix")
+        monkeypatch.setattr(segments, "_tpu_backend", lambda: True)
+        assert segments._use_pallas(100_000, 4096) is True
+        # "radix" only relaxes the >2048 gate, not the element floor
+        assert segments._use_pallas(100, 4096) is False
+        # nor the ceiling
+        assert segments._use_pallas(100_000, 32_768) is False
+
+    def test_force_flag_overrides_element_floor(self, monkeypatch):
+        from cruise_control_tpu.ops import segments
+
+        monkeypatch.setenv("CC_TPU_PALLAS_SEGMENTS", "force")
+        monkeypatch.setattr(segments, "_tpu_backend", lambda: False)
+        assert segments._use_pallas(100, 512) is True
+
+    def test_disable_flag_wins(self, monkeypatch):
+        from cruise_control_tpu.ops import segments
+
+        monkeypatch.setenv("CC_TPU_PALLAS_SEGMENTS", "0")
+        monkeypatch.setattr(segments, "_tpu_backend", lambda: True)
+        assert segments._use_pallas(100_000, 1024) is False
