@@ -118,7 +118,9 @@ def _op_result_json(op: OperationResult) -> dict:
                 "completed": op.execution.completed,
                 "dead": op.execution.dead,
                 "aborted": op.execution.aborted,
+                "failed": op.execution.failed,
                 "stopped": op.execution.stopped,
+                "error": op.execution.error,
                 "durationS": round(op.execution.duration_s, 3),
             }
         ),

@@ -24,9 +24,11 @@ from cruise_control_tpu.backend.base import ClusterBackend
 from cruise_control_tpu.core.config import Config, ConfigException, resolve_class
 from cruise_control_tpu.core.config_defs import cruise_control_config
 from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.core.retry import RetryPolicy
 from cruise_control_tpu.detector.detectors import (
     BrokerFailureDetector,
     DiskFailureDetector,
+    ExecutionFailureDetector,
     GoalViolationDetector,
     SlowBrokerFinder,
     TopicReplicationFactorAnomalyFinder,
@@ -176,6 +178,18 @@ class CruiseControlTpuApp:
             min_samples_per_window=cfg.get("min.samples.per.partition.metrics.window"),
             sample_store=store if not cfg.get("skip.loading.samples") else None,
         )
+        max_retries = cfg.get("backend.request.max.retries")
+        retry_policy = (
+            RetryPolicy(
+                # the knob counts retries *after* the first attempt
+                max_attempts=max_retries + 1,
+                base_backoff_s=cfg.get("backend.request.retry.backoff.ms") / 1000.0,
+                deadline_s=cfg.get("backend.request.retry.deadline.ms") / 1000.0,
+            )
+            if max_retries and max_retries > 0
+            else None
+        )
+        task_timeout_ms = cfg.get("execution.task.timeout.ms")
         self.executor = Executor(
             backend,
             concurrency=ConcurrencyConfig(
@@ -188,6 +202,9 @@ class CruiseControlTpuApp:
             notifier=cfg.get_configured_instance("executor.notifier.class", ExecutorNotifier),
             pause_sampling=self.monitor.pause_sampling,
             resume_sampling=self.monitor.resume_sampling,
+            retry_policy=retry_policy,
+            task_timeout_s=(task_timeout_ms / 1000.0) if task_timeout_ms else None,
+            rollback_stuck_tasks=cfg.get("execution.task.rollback.on.timeout"),
         )
         self.cruise_control = CruiseControl(
             backend,
@@ -229,6 +246,10 @@ class CruiseControlTpuApp:
             (
                 TopicReplicationFactorAnomalyFinder(backend),
                 _iv("topic.anomaly.detection.interval.ms"),
+            ),
+            (
+                ExecutionFailureDetector(self.executor),
+                _iv("execution.failure.detection.interval.ms"),
             ),
         ]
         notifier_cls = resolve_class(cfg.get("anomaly.notifier.class"))

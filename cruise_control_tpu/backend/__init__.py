@@ -16,15 +16,21 @@ from cruise_control_tpu.backend.base import (
     LogdirInfo,
     PartitionInfo,
     RawMetric,
+    ReassignmentInProgress,
 )
+from cruise_control_tpu.backend.chaos import ChaosBackend, ChaosInjectedError, FaultPlan
 from cruise_control_tpu.backend.fake import FakeClusterBackend
 
 __all__ = [
     "BrokerInfo",
+    "ChaosBackend",
+    "ChaosInjectedError",
     "ClusterBackend",
     "ClusterDescription",
+    "FaultPlan",
     "LogdirInfo",
     "PartitionInfo",
     "RawMetric",
+    "ReassignmentInProgress",
     "FakeClusterBackend",
 ]

@@ -95,8 +95,11 @@ class ClusterBackend(abc.ABC):
 
     @abc.abstractmethod
     def alter_partition_reassignments(
-        self, reassignments: Mapping[TopicPartition, Sequence[int]]
-    ) -> None: ...
+        self, reassignments: Mapping[TopicPartition, Optional[Sequence[int]]]
+    ) -> None:
+        """tp -> target replica list.  A ``None`` target *cancels* an in-flight
+        reassignment for that partition (Kafka's AlterPartitionReassignments
+        empty-target semantics), leaving the pre-reassignment replica set."""
 
     @abc.abstractmethod
     def list_partition_reassignments(self) -> Dict[TopicPartition, Tuple[Tuple[int, ...], Tuple[int, ...]]]:

@@ -280,9 +280,18 @@ class FakeClusterBackend(ClusterBackend):
     # -- admin operations --------------------------------------------------
 
     def alter_partition_reassignments(
-        self, reassignments: Mapping[TopicPartition, Sequence[int]]
+        self, reassignments: Mapping[TopicPartition, Optional[Sequence[int]]]
     ) -> None:
         with self._lock:
+            cancels = {tp for tp, target in reassignments.items() if target is None}
+            for tp in cancels:
+                # None target = cancel (Kafka empty-target semantics): drop the
+                # in-flight reassignment, replicas stay at the pre-move set
+                self._reassignments.pop(tp, None)
+                self.admin_log.append(("cancel", tp))
+            reassignments = {
+                tp: target for tp, target in reassignments.items() if target is not None
+            }
             for tp in reassignments:
                 if tp in self._reassignments:
                     raise ReassignmentInProgress(f"{tp} already reassigning")

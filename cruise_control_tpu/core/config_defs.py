@@ -157,6 +157,20 @@ def executor_config() -> ConfigDef:
              "Retention of broker demotion history.", in_range(lo=0))
     d.define("removal.history.retention.time.ms", Type.LONG, 86_400_000, L,
              "Retention of broker removal history.", in_range(lo=0))
+    d.define("backend.request.max.retries", Type.INT, 4, M,
+             "Retries per southbound backend call after the first attempt "
+             "(0 disables retry).", in_range(lo=0))
+    d.define("backend.request.retry.backoff.ms", Type.LONG, 100, L,
+             "Base exponential-backoff delay between backend-call retries.",
+             in_range(lo=1))
+    d.define("backend.request.retry.deadline.ms", Type.LONG, 30_000, L,
+             "Overall wall budget per backend call across retries.", in_range(lo=1))
+    d.define("execution.task.timeout.ms", Type.LONG, None, M,
+             "In-flight reassignments stuck longer than this are marked DEAD "
+             "instead of spinning the phase; unset = no per-task timeout.")
+    d.define("execution.task.rollback.on.timeout", Type.BOOLEAN, False, L,
+             "Cancel a timed-out reassignment server-side so the partition "
+             "reverts to its pre-move replica set.")
     return d
 
 
@@ -175,6 +189,8 @@ def anomaly_detector_config() -> ConfigDef:
              "Metric-anomaly (slow broker) cadence; unset = anomaly.detection.interval.ms.")
     d.define("topic.anomaly.detection.interval.ms", Type.LONG, None, M,
              "Topic-anomaly cadence; unset = anomaly.detection.interval.ms.")
+    d.define("execution.failure.detection.interval.ms", Type.LONG, None, M,
+             "Execution-failure detector cadence; unset = anomaly.detection.interval.ms.")
     d.define("anomaly.detection.goals", Type.LIST, "", M,
              "Goal names the violation detector re-optimizes; empty = default list.")
     d.define("anomaly.notifier.class", Type.CLASS,

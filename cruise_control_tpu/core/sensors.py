@@ -186,5 +186,12 @@ VALID_WINDOWS_GAUGE = "LoadMonitor.valid-windows"
 MONITORED_PARTITIONS_GAUGE = "LoadMonitor.monitored-partitions-percentage"
 EXECUTION_STARTED_COUNTER = "Executor.execution-started"
 EXECUTION_STOPPED_COUNTER = "Executor.execution-stopped"
+EXECUTION_FAILED_COUNTER = "Executor.execution-failed"
+STUCK_TASKS_COUNTER = "Executor.stuck-tasks-timed-out"
+RETRY_COUNTER = "RetryPolicy.retries"
+RETRY_EXHAUSTED_COUNTER = "RetryPolicy.retries-exhausted"
+RETRY_FATAL_COUNTER = "RetryPolicy.fatal-errors"
+CHAOS_FAULTS_COUNTER = "ChaosBackend.faults-injected"
+FETCHER_REPLACED_COUNTER = "MetricFetcherManager.hung-fetchers-replaced"
 FLIGHT_TRACES_COUNTER = "FlightRecorder.traces-recorded"
 FLIGHT_RING_GAUGE = "FlightRecorder.ring-size"

@@ -8,6 +8,7 @@ from cruise_control_tpu.detector.anomalies import (
     AnomalyType,
     BrokerFailures,
     DiskFailures,
+    ExecutionFailure,
     GoalViolations,
     MaintenanceEvent,
     MaintenanceEventType,
@@ -21,6 +22,7 @@ from cruise_control_tpu.detector.detectors import (
     BrokerFailureDetector,
     Detector,
     DiskFailureDetector,
+    ExecutionFailureDetector,
     GoalViolationDetector,
     MaintenanceEventDetector,
     SlowBrokerFinder,
@@ -56,6 +58,8 @@ __all__ = [
     "Detector",
     "DiskFailureDetector",
     "DiskFailures",
+    "ExecutionFailure",
+    "ExecutionFailureDetector",
     "GoalViolationDetector",
     "GoalViolations",
     "MaintenanceEvent",

@@ -28,6 +28,8 @@ class AnomalyType(enum.IntEnum):
     GOAL_VIOLATION = 3
     TOPIC_ANOMALY = 4
     MAINTENANCE_EVENT = 5
+    #: a proposal execution degraded (fatal backend error / dead / stuck tasks)
+    EXECUTION_FAILURE = 6
 
 
 class NotificationAction(enum.Enum):
@@ -214,6 +216,32 @@ class MaintenanceEvent(Anomaly):
     def dedupe_key(self) -> tuple:
         """IdempotenceCache key (MaintenanceEventDetector's dedupe)."""
         return (self.event_type, tuple(sorted(self.broker_ids)))
+
+
+@dataclasses.dataclass
+class ExecutionFailure(Anomaly):
+    """A proposal execution finished degraded — fatal backend error, dead or
+    stuck (timed-out) tasks, or tasks lost mid-phase.  The cluster may be
+    mid-move in an unplanned intermediate state, so the fix is a fresh
+    rebalance: the optimizer re-reads live metadata and converges from
+    wherever the failed execution actually left the replicas."""
+
+    execution_id: int = 0
+    error: Optional[str] = None
+    dead_tasks: int = 0
+    failed_tasks: int = 0
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.EXECUTION_FAILURE
+
+    def fix_with(self, cc):
+        return cc.rebalance(dryrun=False, triggered_by_violation=True)
+
+    def description(self) -> str:
+        return (
+            f"ExecutionFailure{{id={self.execution_id}, dead={self.dead_tasks}, "
+            f"failed={self.failed_tasks}, error={self.error!r}}}"
+        )
 
 
 @dataclasses.dataclass

@@ -271,6 +271,36 @@ class TopicReplicationFactorAnomalyFinder(Detector):
         return []
 
 
+class ExecutionFailureDetector(Detector):
+    """Surfaces degraded execution summaries as :class:`ExecutionFailure`
+    anomalies so self-healing can converge the cluster after a botched
+    execution.
+
+    Consumes the executor's degraded-summary queue
+    (:meth:`Executor.drain_degraded_summaries`) rather than polling
+    ``last_summary``, so a degraded run is never lost when a newer execution
+    overwrites the summary between detector cycles; each summary is reported
+    exactly once.  Stopped-by-operator executions never enter the queue."""
+
+    name = "ExecutionFailureDetector"
+
+    def __init__(self, executor) -> None:
+        self.executor = executor
+
+    def run(self) -> List[Anomaly]:
+        from cruise_control_tpu.detector.anomalies import ExecutionFailure
+
+        return [
+            ExecutionFailure(
+                execution_id=s.execution_id,
+                error=s.error,
+                dead_tasks=s.dead,
+                failed_tasks=s.failed,
+            )
+            for s in self.executor.drain_degraded_summaries()
+        ]
+
+
 class MaintenanceEventDetector(Detector):
     """Continuous reader of a maintenance-event source with idempotence dedupe
     (MaintenanceEventDetector + IdempotenceCache)."""

@@ -136,8 +136,14 @@ class AnomalyDetectorManager:
     def _handler_loop(self) -> None:
         while not self._stop.is_set():
             anomaly = self._next_anomaly(timeout_s=0.2)
-            if anomaly is not None:
+            if anomaly is None:
+                continue
+            try:
                 self.handle_anomaly(anomaly)
+            except Exception:
+                # a raising notifier/anomaly must never kill the self-healing
+                # loop for the rest of the process lifetime — count and go on
+                self.num_self_healing_failed += 1
 
     def _next_anomaly(self, timeout_s: float) -> Optional[Anomaly]:
         with self._cv:

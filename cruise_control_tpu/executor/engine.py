@@ -18,7 +18,8 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
-from cruise_control_tpu.backend.base import ClusterBackend
+from cruise_control_tpu.backend.base import ClusterBackend, ReassignmentInProgress
+from cruise_control_tpu.core.retry import RetryPolicy
 from cruise_control_tpu.executor.concurrency import (
     ConcurrencyAdjuster,
     ConcurrencyConfig,
@@ -54,14 +55,80 @@ class ExecutionSummary:
     dead: int
     aborted: int
     duration_s: float
+    #: tasks still IN_PROGRESS/ABORTING when the execution unwound (fatal
+    #: backend error or thread teardown) — no other bucket claims them, so
+    #: completed + dead + aborted + failed == total always holds
+    failed: int = 0
+    #: fatal error that degraded the execution (None on a clean run)
+    error: Optional[str] = None
+
+    @property
+    def total(self) -> int:
+        return self.completed + self.dead + self.aborted + self.failed
 
     @property
     def succeeded(self) -> bool:
-        return not self.stopped and self.dead == 0 and self.aborted == 0
+        return (
+            not self.stopped
+            and self.dead == 0
+            and self.aborted == 0
+            and self.failed == 0
+            and self.error is None
+        )
 
 
 class OngoingExecutionError(Exception):
     """An execution is already in progress (Executor.executeProposals rejects)."""
+
+
+class _RetryingBackend:
+    """Engine-internal proxy: southbound calls run under the executor's
+    :class:`RetryPolicy`; everything else delegates untouched.  Duck-typed
+    (not a :class:`ClusterBackend` subclass) so test-helper attributes on the
+    wrapped backend stay reachable."""
+
+    _RETRIED = frozenset(
+        {
+            "describe_cluster",
+            "describe_topics",
+            "describe_logdirs",
+            "alter_partition_reassignments",
+            "list_partition_reassignments",
+            "elect_leaders",
+            "alter_replica_logdirs",
+            "set_replication_throttles",
+            "clear_replication_throttles",
+        }
+    )
+
+    def __init__(self, inner: ClusterBackend, policy: RetryPolicy) -> None:
+        self._inner = inner
+        self._policy = policy
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name in self._RETRIED and callable(attr):
+            policy = self._policy
+            # a replayed reassignment answered with ReassignmentInProgress
+            # means the lost-response attempt actually applied — success, not
+            # a fatal conflict (alter is the one non-idempotent retried call)
+            assume_applied = (
+                (ReassignmentInProgress,)
+                if name == "alter_partition_reassignments"
+                else ()
+            )
+
+            def retried(*args, **kwargs):
+                return policy.call(
+                    attr,
+                    *args,
+                    op_name=f"backend.{name}",
+                    assume_applied_on=assume_applied,
+                    **kwargs,
+                )
+
+            return retried
+        return attr
 
 
 class Executor:
@@ -77,9 +144,19 @@ class Executor:
         pause_sampling: Optional[Callable[[str], None]] = None,
         resume_sampling: Optional[Callable[[str], None]] = None,
         min_insync_replicas: int = 1,
+        retry_policy: Optional[RetryPolicy] = None,
+        task_timeout_s: Optional[float] = None,
+        rollback_stuck_tasks: bool = False,
     ) -> None:
         self.min_insync_replicas = min_insync_replicas
-        self.backend = backend
+        self.retry_policy = retry_policy
+        #: in-flight tasks stuck longer than this are marked DEAD instead of
+        #: spinning the phase to max_progress_checks (None = no timeout)
+        self.task_timeout_s = task_timeout_s
+        #: on stuck-task timeout, also cancel the reassignment (None target,
+        #: Kafka empty-target semantics) so replicas revert to old_replicas
+        self.rollback_stuck_tasks = rollback_stuck_tasks
+        self.backend = backend if retry_policy is None else _RetryingBackend(backend, retry_policy)
         self.concurrency = ExecutionConcurrencyManager(concurrency or ConcurrencyConfig())
         self.adjuster = ConcurrencyAdjuster(self.concurrency)
         self.strategies = list(strategies)
@@ -97,11 +174,22 @@ class Executor:
         self._execution_ids = iter(range(1, 1 << 31))
         self._last_summary: Optional[ExecutionSummary] = None
         self._planner: Optional[ExecutionTaskPlanner] = None
+        #: degraded summaries awaiting the ExecutionFailureDetector's drain —
+        #: a queue (not just last_summary) so a degraded run isn't lost when a
+        #: newer execution overwrites the summary before the next detector cycle
+        self._degraded_summaries: List[ExecutionSummary] = []
+        self._degraded_cap = 16
 
     # -- public API ----------------------------------------------------------
 
     @property
     def state(self) -> str:
+        # STOPPING is derived, not stored: a stop request must never be able
+        # to pin the state past the execution thread's death (the thread owns
+        # every stored-state transition; once it exits, this reverts to the
+        # stored NO_TASK_IN_PROGRESS)
+        if self._stop_signal.is_set() and self.has_ongoing_execution:
+            return ExecutorState.STOPPING_EXECUTION
         return self._state
 
     @property
@@ -111,6 +199,13 @@ class Executor:
     @property
     def last_summary(self) -> Optional[ExecutionSummary]:
         return self._last_summary
+
+    def drain_degraded_summaries(self) -> List[ExecutionSummary]:
+        """Hand pending degraded summaries to the caller exactly once
+        (consumed by the ExecutionFailureDetector)."""
+        with self._lock:
+            out, self._degraded_summaries = self._degraded_summaries, []
+        return out
 
     def execute_proposals(
         self,
@@ -138,12 +233,24 @@ class Executor:
             self._execution_thread.join()
             assert self._last_summary is not None
             return self._last_summary
-        return ExecutionSummary(execution_id, False, 0, 0, 0, 0.0)
+        return ExecutionSummary(
+            execution_id, stopped=False, completed=0, dead=0, aborted=0, duration_s=0.0
+        )
 
     def stop_execution(self) -> None:
-        """STOP_PROPOSAL_EXECUTION endpoint (sets ``_stopSignal``)."""
-        self._state = ExecutorState.STOPPING_EXECUTION
-        self._stop_signal.set()
+        """STOP_PROPOSAL_EXECUTION endpoint (sets ``_stopSignal``).
+
+        No-op on an idle executor — otherwise the state would read
+        STOPPING_EXECUTION forever with nothing to stop.  Only the signal is
+        set here; the STOPPING state is derived in :attr:`state` so a stop
+        racing the execution thread's teardown can't outlive the thread."""
+        from cruise_control_tpu.core.sensors import EXECUTION_STOPPED_COUNTER, REGISTRY
+
+        with self._lock:
+            if not self.has_ongoing_execution:
+                return
+            self._stop_signal.set()
+        REGISTRY.counter(EXECUTION_STOPPED_COUNTER).inc()
 
     def await_completion(self, timeout_s: float = 60.0) -> Optional[ExecutionSummary]:
         t = self._execution_thread
@@ -155,6 +262,7 @@ class Executor:
 
     def _run_execution(self, execution_id: int, planner: ExecutionTaskPlanner) -> None:
         from cruise_control_tpu.core.sensors import (
+            EXECUTION_FAILED_COUNTER,
             EXECUTION_STARTED_COUNTER,
             PROPOSAL_EXECUTION_TIMER,
             REGISTRY,
@@ -166,9 +274,23 @@ class Executor:
         t0 = time.monotonic()
         REGISTRY.counter(EXECUTION_STARTED_COUNTER).inc()
         throttle = ReplicationThrottleHelper(self.backend, self.throttle_rate_bytes)
+        error: Optional[str] = None
+        cleanup_errors: List[str] = []
+
+        def _cleanup(label: str, fn: Callable[[], None]) -> None:
+            # cleanup steps run independently: one failing step (e.g. a
+            # throttle-clear whose retries exhaust) must not skip the rest
+            try:
+                fn()
+            except Exception as ce:
+                cleanup_errors.append(f"{label}: {type(ce).__name__}: {ce}")
+
         if self._pause_sampling and planner.inter_broker:
             # pause partition sampling while replicas move (:1414)
-            self._pause_sampling("executor: inter-broker replica movement")
+            _cleanup(
+                "pause_sampling",
+                lambda: self._pause_sampling("executor: inter-broker replica movement"),
+            )
         try:
             for name, tasks, phase in (
                 ("inter_broker", planner.inter_broker,
@@ -186,10 +308,18 @@ class Executor:
                         attrs={"tasks": len(tasks)},
                     )
                 )
+        except Exception as e:
+            # a fatal backend error degrades to a summary with error set —
+            # never a silently-dead daemon thread
+            error = f"{type(e).__name__}: {e}"
+            REGISTRY.counter(EXECUTION_FAILED_COUNTER).inc()
         finally:
-            throttle.clear_throttles()
+            _cleanup("clear_throttles", throttle.clear_throttles)
             if self._resume_sampling and planner.inter_broker:
-                self._resume_sampling("executor: execution finished")
+                _cleanup(
+                    "resume_sampling",
+                    lambda: self._resume_sampling("executor: execution finished"),
+                )
             counts = {s: 0 for s in TaskState}
             for t in planner.all_tasks:
                 counts[t.state] += 1
@@ -199,11 +329,23 @@ class Executor:
                 completed=counts[TaskState.COMPLETED],
                 dead=counts[TaskState.DEAD],
                 aborted=counts[TaskState.ABORTED] + counts[TaskState.PENDING],
+                failed=counts[TaskState.IN_PROGRESS] + counts[TaskState.ABORTING],
                 duration_s=time.monotonic() - t0,
+                error=error,
             )
-            REGISTRY.timer(PROPOSAL_EXECUTION_TIMER).update(self._last_summary.duration_s)
+            s = self._last_summary
+            if not s.stopped and (s.error is not None or s.dead or s.failed):
+                with self._lock:
+                    self._degraded_summaries.append(s)
+                    del self._degraded_summaries[: -self._degraded_cap]
+            _cleanup(
+                "execution_timer",
+                lambda: REGISTRY.timer(PROPOSAL_EXECUTION_TIMER).update(
+                    self._last_summary.duration_s
+                ),
+            )
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
-            obs.finish_trace(
+            obs.finish_trace(       # never raises (observability contract)
                 trace_token,
                 spans=phase_spans,
                 attrs={
@@ -212,9 +354,15 @@ class Executor:
                     "completed": self._last_summary.completed,
                     "dead": self._last_summary.dead,
                     "aborted": self._last_summary.aborted,
+                    "failed": self._last_summary.failed,
+                    "error": error,
+                    "cleanup_errors": cleanup_errors,
                 },
             )
-            self.notifier.on_execution_finished(self._last_summary)
+            _cleanup(
+                "notifier",
+                lambda: self.notifier.on_execution_finished(self._last_summary),
+            )
 
     def _now_ms(self) -> int:
         return int(time.time() * 1000)
@@ -266,7 +414,8 @@ class Executor:
         self, planner: ExecutionTaskPlanner, in_flight: List[ExecutionTask]
     ) -> List[ExecutionTask]:
         """One progress-check interval: completed = no longer listed as reassigning;
-        dead = a destination broker died (ExecutionUtils progress semantics)."""
+        dead = a destination broker died (ExecutionUtils progress semantics) or
+        the task sat in flight past ``task_timeout_s`` (stuck reassignment)."""
         ongoing = set(self.backend.list_partition_reassignments().keys())
         alive = {
             b for b, i in self.backend.describe_cluster().brokers.items() if i.alive
@@ -278,6 +427,8 @@ class Executor:
                 t.transition(TaskState.COMPLETED, now)
             elif not set(t.proposal.replicas_to_add) <= alive:
                 t.transition(TaskState.DEAD, now)
+            elif self._task_expired(t, now):
+                self._kill_stuck_task(t, now)
             else:
                 still.append(t)
         # concurrency auto-adjustment tick from cluster health (AIMD)
@@ -318,11 +469,27 @@ class Executor:
             return
         if planner.leadership:
             self._state = ExecutorState.LEADER_MOVEMENT
+        # partitions whose inter-broker move died/aborted never reached
+        # new_replicas — "reordering" them would submit a fresh data move
+        failed_moves = {
+            t.proposal.tp
+            for t in planner.inter_broker
+            if t.state in (TaskState.DEAD, TaskState.ABORTED)
+        }
         while not self._stop_signal.is_set():
             batch = planner.ready_leadership_batch(self.concurrency.config.leadership_batch)
             if not batch:
                 break
             now = self._now_ms()
+            live = []
+            for t in batch:
+                if t.proposal.tp in failed_moves:
+                    t.transition(TaskState.ABORTED, now)
+                else:
+                    live.append(t)
+            batch = live
+            if not batch:
+                continue
             for t in batch:
                 t.transition(TaskState.IN_PROGRESS, now)
             # a leadership change = replica-list reorder (preferred leader first)
@@ -333,23 +500,63 @@ class Executor:
                 for t in batch
                 if t.proposal.new_replicas != t.proposal.old_replicas
             }
+            stuck_tps = set()
             if reorder:
                 self.backend.alter_partition_reassignments(reorder)
                 checks = 0
+                t_reorder0 = time.monotonic()
                 while checks < self.max_progress_checks:
-                    ongoing = set(self.backend.list_partition_reassignments())
-                    if not (ongoing & set(reorder)):
+                    pending = set(self.backend.list_partition_reassignments()) & set(reorder)
+                    if not pending:
+                        break
+                    if (
+                        self.task_timeout_s is not None
+                        and time.monotonic() - t_reorder0 >= self.task_timeout_s
+                    ):
+                        # stalled reorders get the same stuck-task treatment
+                        # as inter-broker moves: DEAD, never fake-COMPLETED
+                        stuck_tps = pending
                         break
                     checks += 1
                     time.sleep(self.progress_check_interval_s)
-            self.backend.elect_leaders([t.proposal.tp for t in batch])
             now = self._now_ms()
+            live = []
             for t in batch:
+                if t.proposal.tp in stuck_tps:
+                    self._kill_stuck_task(t, now)
+                else:
+                    live.append(t)
+            if live:
+                self.backend.elect_leaders([t.proposal.tp for t in live])
+            now = self._now_ms()
+            for t in live:
                 t.transition(TaskState.COMPLETED, now)
         if self._stop_signal.is_set():
             self._abort_pending(planner.leadership)
 
     # -- helpers -------------------------------------------------------------
+
+    def _task_expired(self, t: ExecutionTask, now_ms: int) -> bool:
+        return (
+            self.task_timeout_s is not None
+            and t.start_ms is not None
+            and now_ms - t.start_ms >= self.task_timeout_s * 1000.0
+        )
+
+    def _kill_stuck_task(self, t: ExecutionTask, now_ms: int) -> None:
+        """A reassignment that outlived ``task_timeout_s`` is DEAD; optionally
+        cancel it server-side so the partition reverts to ``old_replicas``."""
+        from cruise_control_tpu.core.sensors import REGISTRY, STUCK_TASKS_COUNTER
+
+        t.transition(TaskState.DEAD, now_ms)
+        REGISTRY.counter(STUCK_TASKS_COUNTER).inc()
+        if self.rollback_stuck_tasks:
+            try:
+                self.backend.alter_partition_reassignments({t.proposal.tp: None})
+            except Exception:
+                # best-effort: a backend that can't cancel still gets the DEAD
+                # marking; the reassignment finishes (or not) server-side
+                pass
 
     def _abort_pending(self, pool: List[ExecutionTask]) -> None:
         now = self._now_ms()

@@ -346,8 +346,21 @@ class CruiseControl:
 
     def state(self) -> Dict[str, object]:
         ms = self.monitor.state()
+        last = self.executor.last_summary
         return {
             "MonitorState": dataclasses.asdict(ms),
-            "ExecutorState": {"state": self.executor.state},
+            "ExecutorState": {
+                "state": self.executor.state,
+                "lastExecution": None if last is None else {
+                    "executionId": last.execution_id,
+                    "completed": last.completed,
+                    "dead": last.dead,
+                    "aborted": last.aborted,
+                    "failed": last.failed,
+                    "stopped": last.stopped,
+                    "error": last.error,
+                    "durationS": round(last.duration_s, 3),
+                },
+            },
             "uptime_s": time.time() - self._start_time,
         }

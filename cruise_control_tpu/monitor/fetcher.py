@@ -20,7 +20,11 @@ import concurrent.futures
 from typing import Callable, List, Optional, Sequence
 
 from cruise_control_tpu.backend.base import TopicPartition
-from cruise_control_tpu.core.sensors import REGISTRY, SAMPLE_FETCH_TIMER
+from cruise_control_tpu.core.sensors import (
+    FETCHER_REPLACED_COUNTER,
+    REGISTRY,
+    SAMPLE_FETCH_TIMER,
+)
 from cruise_control_tpu.monitor.samples import MetricSampler, SampleBatch
 
 
@@ -82,43 +86,75 @@ class FetcherPool(MetricSampler):
         self.assignor = assignor or DefaultPartitionAssignor()
         self.list_partitions = list_partitions
         self.timeout_s = timeout_s
+        self._sampler_factory = sampler_factory
         self._samplers = [sampler_factory() for _ in range(self.num_fetchers)]
-        self._pool = concurrent.futures.ThreadPoolExecutor(
+        self._abandoned: List[MetricSampler] = []
+        self._pool = self._new_pool()
+
+    def _new_pool(self) -> concurrent.futures.ThreadPoolExecutor:
+        return concurrent.futures.ThreadPoolExecutor(
             max_workers=self.num_fetchers, thread_name_prefix="metric-fetcher"
         )
 
     def get_samples(self, from_ms: int, to_ms: int) -> SampleBatch:
         partitions = list(self.list_partitions())
         assignment = self.assignor.assign(partitions, self.num_fetchers)
-        futures = []
         with REGISTRY.timer(SAMPLE_FETCH_TIMER).time():
-            for sampler, assigned in zip(self._samplers, assignment):
+            futures = {}          # future -> sampler slot
+            for slot, (sampler, assigned) in enumerate(zip(self._samplers, assignment)):
                 if not assigned:
                     continue
                 wrapped = PartitionFilteringSampler(sampler, assigned)
-                futures.append(self._pool.submit(wrapped.get_samples, from_ms, to_ms))
+                futures[self._pool.submit(wrapped.get_samples, from_ms, to_ms)] = slot
+            done, hung = concurrent.futures.wait(futures, timeout=self.timeout_s)
             psamples, bsamples = [], []
             seen_brokers = set()
-            try:
-                for fut in concurrent.futures.as_completed(futures, timeout=self.timeout_s):
-                    try:
-                        batch = fut.result()
-                    except Exception:
-                        continue  # partial batch beats a failed round
-                    psamples.extend(batch.partition_samples)
-                    # broker samples arrive from every fetcher; dedupe by (broker, ts)
-                    for b in batch.broker_samples:
-                        key = (b.broker_id, b.ts_ms)
-                        if key not in seen_brokers:
-                            seen_brokers.add(key)
-                            bsamples.append(b)
-            except concurrent.futures.TimeoutError:
+            for fut in done:
+                try:
+                    batch = fut.result()
+                except Exception:
+                    continue  # partial batch beats a failed round
+                psamples.extend(batch.partition_samples)
+                # broker samples arrive from every fetcher; dedupe by (broker, ts)
+                for b in batch.broker_samples:
+                    key = (b.broker_id, b.ts_ms)
+                    if key not in seen_brokers:
+                        seen_brokers.add(key)
+                        bsamples.append(b)
+            if hung:
                 # a hung fetcher forfeits its share; keep what the others got
                 # (the degrade-to-partial contract — never fail the round)
-                pass
+                self._replace_hung(sorted(futures[f] for f in hung), hung)
         return SampleBatch(psamples, bsamples)
 
+    def _replace_hung(self, slots, hung_futures) -> None:
+        """Replace poisoned workers so repeated hangs can't exhaust the pool.
+
+        A timed-out future's worker thread stays occupied for as long as the
+        sampler call blocks; abandoning it in the shared executor would leak
+        one worker per hang until every slot is dead.  Instead: cancel what
+        can be cancelled, swap in fresh sampler instances for the hung slots
+        (the old ones may be blocked mid-call and are unsafe to reuse), and
+        retire the whole executor for a fresh one — the old executor's
+        threads die off as their calls return (or never, in which case they
+        hold only abandoned objects, not pool capacity)."""
+        for f in hung_futures:
+            f.cancel()
+        for slot in slots:
+            # evicted samplers may be blocked mid-call; keep them for close()
+            # so their connections/handles are still released at shutdown
+            self._abandoned.append(self._samplers[slot])
+            self._samplers[slot] = self._sampler_factory()
+        old = self._pool
+        self._pool = self._new_pool()
+        old.shutdown(wait=False, cancel_futures=True)
+        REGISTRY.counter(FETCHER_REPLACED_COUNTER).inc(len(slots))
+
     def close(self) -> None:
-        for s in self._samplers:
-            s.close()
+        for s in self._samplers + self._abandoned:
+            try:
+                s.close()
+            except Exception:
+                pass
+        self._abandoned.clear()
         self._pool.shutdown(wait=False)

@@ -15,6 +15,9 @@ python -m compileall -q cruise_control_tpu tests scripts bench.py bench_scale.py
 echo "== fast tier =="
 python -m pytest tests/ -x -q -m "not slow"
 
+echo "== chaos tier (seeded fault injection; deterministic, also part of fast tier) =="
+python -m pytest tests/ -x -q -m chaos
+
 echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check) =="
 python scripts/bench_gate.py
 

@@ -185,6 +185,62 @@ class TestFetcherPool:
         assert len(calls) == 2
         pool.close()
 
+    def test_repeated_hangs_still_yield_partial_batches(self):
+        """Regression: a timed-out fetcher's worker thread stayed occupied, so
+        N consecutive hangs permanently exhausted the pool.  Poisoned workers
+        are now replaced — every round still returns the healthy topic's share."""
+        import threading
+
+        release = threading.Event()
+        partitions = [("hang", 0), ("ok", 0)]
+
+        closed = []
+
+        class MaybeHangingSampler(MetricSampler):
+            def __init__(self, hangs):
+                self.hangs = hangs
+
+            def get_samples(self, from_ms, to_ms):
+                if self.hangs:
+                    release.wait(30)        # parked until test teardown
+                samples = [
+                    PartitionMetricSample(tp, 0, to_ms, (1.0, 2.0)) for tp in partitions
+                ]
+                return SampleBatch(samples, [])
+
+            def close(self):
+                closed.append(self)
+
+        # assignor puts topic "hang" on slot 0 and "ok" on slot 1; creation
+        # order is [slot0, slot1], and every replacement refills the hung
+        # slot 0 — so every sampler except the second one hangs
+        made = []
+
+        def factory():
+            s = MaybeHangingSampler(hangs=(len(made) != 1))
+            made.append(s)
+            return s
+
+        pool = FetcherPool(
+            sampler_factory=factory,
+            list_partitions=lambda: partitions,
+            num_fetchers=2,
+            timeout_s=0.2,
+        )
+        try:
+            for round_no in range(3):
+                batch = pool.get_samples(0, 1000)
+                got = {s.tp for s in batch.partition_samples}
+                assert ("ok", 0) in got, f"round {round_no}: healthy share lost to hangs"
+                assert ("hang", 0) not in got
+            # one replacement sampler minted per hung round
+            assert len(made) == 2 + 3
+        finally:
+            release.set()
+            pool.close()
+        # evicted (abandoned) samplers are closed too, not just current ones
+        assert set(closed) == set(made)
+
 
 class TestJwtProvider:
     SECRET = "s3cr3t"

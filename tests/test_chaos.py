@@ -1,0 +1,421 @@
+"""Seeded chaos suite: the control plane under injected backend faults.
+
+Every test drives the real executor/monitor/detector against a
+:class:`ChaosBackend` wrapping the fake cluster with a deterministic
+:class:`FaultPlan` (ISSUE-2 fault matrix: raise-N, raise-every-Kth, latency,
+broker flap, stalled reassignment, metric gap), and asserts the hardening
+invariants:
+
+* a complete :class:`ExecutionSummary` is always produced — never a
+  silently-dead daemon thread;
+* task accounting is exact: completed + dead + aborted + failed == total;
+* replication throttles are always cleared;
+* partition sampling is always resumed after being paused;
+* the detector handler loop survives an anomaly whose notifier raises;
+* retry events land in the flight recorder (GET /traces) and retry/fault
+  counters in the sensor registry.
+
+Deterministic by construction (counted fault rules + seeded RNG), so the suite
+runs in tier-1 with no flake budget (``chaos`` marker).
+"""
+
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.api.server import CruiseControlApp
+from cruise_control_tpu.backend import (
+    ChaosBackend,
+    ChaosInjectedError,
+    FakeClusterBackend,
+    FaultPlan,
+)
+from cruise_control_tpu.core.retry import RetryExhaustedError, RetryPolicy
+from cruise_control_tpu.core.sensors import (
+    CHAOS_FAULTS_COUNTER,
+    REGISTRY,
+    RETRY_COUNTER,
+    STUCK_TASKS_COUNTER,
+)
+from cruise_control_tpu.detector import (
+    Anomaly,
+    AnomalyDetectorManager,
+    AnomalyNotifier,
+    AnomalyType,
+    ExecutionFailure,
+    ExecutionFailureDetector,
+    NotificationResult,
+)
+from cruise_control_tpu.executor import Executor, TaskState
+from cruise_control_tpu.obs import RECORDER
+
+pytestmark = pytest.mark.chaos
+
+
+# -- scaffolding --------------------------------------------------------------
+
+
+def make_backend(latency=1):
+    backend = FakeClusterBackend(reassignment_latency_polls=latency)
+    for b in range(4):
+        backend.add_broker(b, rack=str(b % 2))
+    for p in range(6):
+        backend.create_partition(
+            ("T", p), [p % 4, (p + 1) % 4], load=[1.0, 10.0, 10.0, 100.0]
+        )
+    return backend
+
+
+def move_proposal(tp, old, new, size=100.0):
+    return ExecutionProposal(
+        tp=tp, partition_size=size, old_leader=old[0],
+        old_replicas=tuple(old), new_replicas=tuple(new),
+    )
+
+
+def fast_retry(**kw):
+    kw.setdefault("max_attempts", 5)
+    kw.setdefault("base_backoff_s", 0.001)
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("seed", 42)
+    return RetryPolicy(**kw)
+
+
+def make_executor(chaos, sampling_events=None, **kw):
+    ev = sampling_events if sampling_events is not None else []
+    kw.setdefault("retry_policy", fast_retry())
+    kw.setdefault("progress_check_interval_s", 0.005)
+    kw.setdefault("throttle_rate_bytes", 1e6)
+    return Executor(
+        chaos,
+        pause_sampling=lambda r: ev.append(("pause", r)),
+        resume_sampling=lambda r: ev.append(("resume", r)),
+        **kw,
+    ), ev
+
+
+PROPOSALS = [
+    (("T", 0), [0, 1], [2, 1]),
+    (("T", 1), [1, 2], [1, 3]),
+    (("T", 2), [2, 3], [3, 2]),   # leadership-only
+]
+
+
+def run_plan(plan, sampling_events=None, latency=1, **executor_kw):
+    chaos = ChaosBackend(make_backend(latency=latency), plan)
+    executor, events = make_executor(chaos, sampling_events, **executor_kw)
+    summary = executor.execute_proposals(
+        [move_proposal(tp, old, new) for tp, old, new in PROPOSALS]
+    )
+    return chaos, executor, summary, events
+
+
+def assert_invariants(chaos, executor, summary, events):
+    """The hardening contract every fault plan must leave intact."""
+    # summary always produced, thread finished, state reset
+    assert summary is not None
+    assert executor.last_summary is summary
+    assert not executor.has_ongoing_execution
+    assert executor.state == "NO_TASK_IN_PROGRESS"
+    # exact task accounting: every planned task lands in exactly one bucket
+    tasks = executor._planner.all_tasks
+    counts = {s: 0 for s in TaskState}
+    for t in tasks:
+        counts[t.state] += 1
+    assert summary.completed == counts[TaskState.COMPLETED]
+    assert summary.dead == counts[TaskState.DEAD]
+    assert summary.aborted == counts[TaskState.ABORTED] + counts[TaskState.PENDING]
+    assert summary.failed == counts[TaskState.IN_PROGRESS] + counts[TaskState.ABORTING]
+    assert summary.total == len(tasks)
+    # throttles always cleared (delegates through chaos to the inner fake)
+    assert chaos.current_throttle is None
+    # sampling always resumed when it was paused
+    pauses = [e for e in events if e[0] == "pause"]
+    resumes = [e for e in events if e[0] == "resume"]
+    assert len(pauses) == len(resumes)
+    if events:
+        assert events[-1][0] == "resume"
+
+
+# -- the fault matrix ---------------------------------------------------------
+
+
+class TestFaultMatrix:
+    def test_raise_n_times_absorbed_by_retry(self):
+        plan = FaultPlan(seed=7).raise_n_times("alter_partition_reassignments", 2)
+        chaos, executor, summary, events = run_plan(plan)
+        assert_invariants(chaos, executor, summary, events)
+        assert summary.succeeded, vars(summary)
+        assert chaos.faults_by_kind().get("error") == 2
+
+    def test_raise_every_kth_on_progress_checks(self):
+        plan = FaultPlan(seed=7).raise_every("list_partition_reassignments", 2)
+        chaos, executor, summary, events = run_plan(plan, latency=3)
+        assert_invariants(chaos, executor, summary, events)
+        assert summary.succeeded, vars(summary)
+        assert chaos.faults_by_kind().get("error", 0) >= 1
+
+    def test_injected_latency(self):
+        plan = FaultPlan(seed=7).latency("alter_partition_reassignments", 0.02)
+        chaos, executor, summary, events = run_plan(plan)
+        assert_invariants(chaos, executor, summary, events)
+        assert summary.succeeded, vars(summary)
+        assert chaos.faults_by_kind().get("latency", 0) >= 1
+
+    def test_broker_flap_during_execution(self):
+        # broker 3 reports dead for a window of southbound calls mid-execution
+        plan = FaultPlan(seed=7).flap_broker(3, start_call=2, end_call=30)
+        chaos, executor, summary, events = run_plan(plan)
+        assert_invariants(chaos, executor, summary, events)
+        # moves onto broker 3 may die; the accounting must still be exact
+        assert summary.total == len(executor._planner.all_tasks)
+        assert chaos.faults_by_kind().get("flap", 0) >= 1
+
+    def test_stalled_reassignment_marked_dead_not_spinning(self):
+        plan = FaultPlan(seed=7).stall_reassignments(tps=[("T", 0)])
+        chaos, executor, summary, events = run_plan(plan, task_timeout_s=0.05)
+        assert_invariants(chaos, executor, summary, events)
+        assert summary.dead >= 1
+        assert summary.duration_s < 30.0   # bounded by the timeout, not the spin cap
+        assert REGISTRY.counter(STUCK_TASKS_COUNTER).snapshot() >= 1
+
+    def test_stalled_reassignment_rollback_restores_old_replicas(self):
+        plan = FaultPlan(seed=7).stall_reassignments(tps=[("T", 0)])
+        chaos, executor, summary, events = run_plan(
+            plan, task_timeout_s=0.05, rollback_stuck_tasks=True
+        )
+        assert_invariants(chaos, executor, summary, events)
+        assert summary.dead >= 1
+        by_tp = {i.tp: i for infos in chaos.describe_topics().values() for i in infos}
+        # cancelled server-side: the partition reverted to its pre-move set
+        assert set(by_tp[("T", 0)].replicas) == {0, 1}
+        assert not chaos.stalled_reassignments
+
+    def test_metric_feed_gap_degrades_to_empty_fetch(self):
+        plan = FaultPlan(seed=7).metric_gap(1, 3)
+        chaos = ChaosBackend(make_backend(), plan)
+        assert chaos.fetch_raw_metrics(0, 60_000)          # call 1: before gap
+        assert chaos.fetch_raw_metrics(0, 60_000) == []    # call 2: gap
+        assert chaos.fetch_raw_metrics(0, 60_000) == []    # call 3: gap
+        assert chaos.fetch_raw_metrics(0, 60_000)          # call 4: after
+        assert chaos.faults_by_kind().get("metric_gap") == 2
+
+    def test_retry_exhausted_degrades_to_error_summary(self):
+        plan = FaultPlan(seed=7).raise_n_times("alter_partition_reassignments", 99)
+        chaos, executor, summary, events = run_plan(
+            plan, retry_policy=fast_retry(max_attempts=3)
+        )
+        assert_invariants(chaos, executor, summary, events)
+        assert not summary.succeeded
+        assert summary.error is not None and "RetryExhaustedError" in summary.error
+
+    def test_stalled_leadership_reorder_marked_dead_not_completed(self):
+        # (T, 2) is leadership-only: its "reorder" reassignment stalls forever;
+        # without the timeout the phase would spin max_progress_checks and then
+        # mark the task COMPLETED while the reassignment is still in flight
+        plan = FaultPlan(seed=7).stall_reassignments(tps=[("T", 2)])
+        chaos, executor, summary, events = run_plan(plan, task_timeout_s=0.05)
+        assert_invariants(chaos, executor, summary, events)
+        assert summary.dead >= 1
+        lead = [t for t in executor._planner.leadership if t.proposal.tp == ("T", 2)]
+        assert lead and lead[0].state is TaskState.DEAD
+        assert summary.duration_s < 30.0
+
+    def test_replayed_alter_conflict_assumed_applied(self):
+        """Response lost after the mutation applied: the replay answers
+        ReassignmentInProgress, which must read as success, not a fatal
+        conflict that degrades an execution whose moves are running."""
+        from cruise_control_tpu.backend import ReassignmentInProgress
+
+        state = {"applied": 0, "calls": 0}
+
+        def flaky_alter(reassignments):
+            state["calls"] += 1
+            if state["calls"] == 1:
+                state["applied"] += 1           # server side took it...
+                raise ChaosInjectedError("response lost")
+            raise ReassignmentInProgress("already reassigning")
+
+        policy = fast_retry()
+        result = policy.call(
+            flaky_alter, {("T", 0): (2, 1)},
+            op_name="backend.alter_partition_reassignments",
+            assume_applied_on=(ReassignmentInProgress,),
+        )
+        assert result is None and state["applied"] == 1 and state["calls"] == 2
+        # but a FIRST-attempt conflict is still a genuine fatal error
+        with pytest.raises(ReassignmentInProgress):
+            policy.call(
+                lambda r: (_ for _ in ()).throw(ReassignmentInProgress("busy")),
+                {}, assume_applied_on=(ReassignmentInProgress,),
+            )
+
+    def test_fatal_error_mid_flight_counts_failed_tasks(self):
+        # first alter succeeds (tasks go IN_PROGRESS), then every subsequent
+        # progress check raises a non-retryable error -> thread unwinds with
+        # tasks still in flight; they must land in the failed bucket
+        plan = FaultPlan(seed=7).raise_n_times(
+            "list_partition_reassignments", 99, exc=lambda m: ValueError("fatal")
+        )
+        chaos, executor, summary, events = run_plan(plan)
+        assert_invariants(chaos, executor, summary, events)
+        assert summary.error is not None and "ValueError" in summary.error
+        assert summary.failed >= 1
+        assert summary.total == len(executor._planner.all_tasks)
+
+
+class TestDeterminism:
+    def test_same_seed_same_fault_log(self):
+        logs = []
+        for _ in range(2):
+            plan = FaultPlan(seed=123).raise_with_probability("describe_topics", 0.5)
+            chaos = ChaosBackend(make_backend(), plan)
+            for _ in range(20):
+                try:
+                    chaos.describe_topics()
+                except ChaosInjectedError:
+                    pass
+            logs.append(list(chaos.fault_log))
+        assert logs[0] == logs[1]
+        assert logs[0], "seeded coin at p=0.5 over 20 calls must fire"
+
+
+# -- observability surface ----------------------------------------------------
+
+
+class TestObservability:
+    def test_retry_events_in_traces_and_counters_in_sensors(self):
+        before = REGISTRY.counter(RETRY_COUNTER).snapshot()
+        plan = FaultPlan(seed=7).raise_n_times("alter_partition_reassignments", 2)
+        chaos, executor, summary, events = run_plan(plan)
+        assert summary.succeeded
+        assert REGISTRY.counter(RETRY_COUNTER).snapshot() >= before + 2
+        assert REGISTRY.counter(CHAOS_FAULTS_COUNTER).snapshot() >= 2
+        retries = RECORDER.recent(100, kind="retry")
+        assert retries and retries[0].attrs["outcome"] == "success"
+        assert retries[0].attrs["op"] == "backend.alter_partition_reassignments"
+        # the GET /traces handler serves them (kind filter + recorder snapshot)
+        app = CruiseControlApp(cruise_control=None)
+        status, body = app.get_traces({"kind": ["retry"], "limit": ["10"]})
+        assert status == 200
+        assert any(t["attrs"].get("op", "").startswith("backend.") for t in body["traces"])
+
+    def test_execution_trace_carries_failure_fields(self):
+        plan = FaultPlan(seed=7).raise_n_times(
+            "alter_partition_reassignments", 99, exc=lambda m: ValueError("fatal")
+        )
+        chaos, executor, summary, events = run_plan(plan)
+        trace = RECORDER.recent(50, kind="execution")[0]
+        assert trace.attrs["error"] == summary.error
+        assert trace.attrs["failed"] == summary.failed
+        assert (
+            trace.attrs["completed"] + trace.attrs["dead"]
+            + trace.attrs["aborted"] + trace.attrs["failed"]
+        ) == summary.total
+
+
+# -- detector resilience ------------------------------------------------------
+
+
+class _FixCounting(Anomaly):
+    def __init__(self, box):
+        super().__init__()
+        self.anomaly_type = AnomalyType.MAINTENANCE_EVENT
+        self.box = box
+
+    def fix_with(self, cc):
+        self.box.append(self.anomaly_id)
+        return "fixed"
+
+
+class _RaiseOnceNotifier(AnomalyNotifier):
+    def __init__(self):
+        self.raised = False
+
+    def on_anomaly(self, anomaly):
+        if not self.raised:
+            self.raised = True
+            raise RuntimeError("notifier webhook exploded")
+        return NotificationResult.fix()
+
+
+class TestDetectorResilience:
+    def test_handler_loop_survives_raising_notifier(self):
+        fixed = []
+        manager = AnomalyDetectorManager(
+            cruise_control=None, notifier=_RaiseOnceNotifier(), detectors=[]
+        )
+        manager.start_detection()
+        try:
+            manager._enqueue(_FixCounting(fixed))   # notifier raises on this one
+            manager._enqueue(_FixCounting(fixed))   # must still be handled
+            deadline = time.monotonic() + 5.0
+            while len(fixed) < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            handler = manager._threads[-1]
+            assert handler.is_alive(), "handler thread died on a raising notifier"
+            assert len(fixed) == 1
+            assert manager.num_self_healing_failed >= 1
+        finally:
+            manager.shutdown()
+
+    def test_execution_failure_detector_emits_once(self):
+        plan = FaultPlan(seed=7).raise_n_times(
+            "alter_partition_reassignments", 99, exc=lambda m: ValueError("fatal")
+        )
+        chaos, executor, summary, events = run_plan(plan)
+        det = ExecutionFailureDetector(executor)
+        anomalies = det.run()
+        assert len(anomalies) == 1
+        a = anomalies[0]
+        assert isinstance(a, ExecutionFailure)
+        assert a.execution_id == summary.execution_id
+        assert a.error == summary.error
+        assert det.run() == []          # each degraded summary reported once
+
+    def test_degraded_summary_not_lost_to_newer_execution(self):
+        """A clean execution overwriting last_summary before the detector's
+        next cycle must not swallow the earlier degraded run."""
+        plan = FaultPlan(seed=7).raise_n_times(
+            "alter_partition_reassignments", 1, exc=lambda m: ValueError("fatal")
+        )
+        chaos = ChaosBackend(make_backend(), plan)
+        executor, events = make_executor(chaos)
+        det = ExecutionFailureDetector(executor)
+        degraded = executor.execute_proposals(
+            [move_proposal(("T", 0), [0, 1], [2, 1])]
+        )
+        assert degraded.error is not None
+        clean = executor.execute_proposals(
+            [move_proposal(("T", 1), [1, 2], [1, 3])]
+        )
+        assert clean.succeeded
+        assert executor.last_summary is clean
+        anomalies = det.run()           # first cycle after BOTH executions
+        assert [a.execution_id for a in anomalies] == [degraded.execution_id]
+        assert det.run() == []
+
+    def test_execution_failure_detector_ignores_clean_and_stopped(self):
+        chaos, executor, summary, events = run_plan(FaultPlan())
+        assert summary.succeeded
+        assert ExecutionFailureDetector(executor).run() == []
+
+
+# -- stop semantics under chaos ----------------------------------------------
+
+
+class TestStopUnderChaos:
+    def test_stop_mid_execution_with_faults_still_accounts(self):
+        plan = FaultPlan(seed=7).raise_every("list_partition_reassignments", 2)
+        chaos = ChaosBackend(make_backend(latency=50), plan)
+        executor, events = make_executor(chaos)
+        executor.execute_proposals(
+            [move_proposal(tp, old, new) for tp, old, new in PROPOSALS], wait=False
+        )
+        time.sleep(0.03)
+        executor.stop_execution()
+        summary = executor.await_completion(timeout_s=30)
+        assert summary is not None and summary.stopped
+        assert_invariants(chaos, executor, summary, events)

@@ -14,6 +14,7 @@ from cruise_control_tpu.executor import (
     ExecutionTaskPlanner,
     Executor,
     ExecutionConcurrencyManager,
+    ExecutorNotifier,
     OngoingExecutionError,
     PrioritizeSmallReplicaMovementStrategy,
     StrategyContext,
@@ -122,6 +123,71 @@ class TestExecution:
         executor.stop_execution()
         summary = executor.await_completion(timeout_s=30)
         assert summary is not None and summary.stopped
+
+    def test_stop_execution_on_idle_executor_is_noop(self):
+        """Regression: stop on an idle executor used to pin the state to
+        STOPPING_EXECUTION forever with nothing to stop."""
+        backend = make_backend()
+        executor = Executor(backend)
+        executor.stop_execution()
+        assert executor.state == "NO_TASK_IN_PROGRESS"
+        # and a fresh execution still starts normally afterwards
+        summary = executor.execute_proposals([move_proposal(("T", 0), [0, 1], [2, 1])])
+        assert summary.succeeded
+        assert not summary.stopped
+        executor.stop_execution()   # after completion: also a no-op
+        assert executor.state == "NO_TASK_IN_PROGRESS"
+
+    def test_lost_task_accounting_on_thread_unwind(self):
+        """Regression: tasks still IN_PROGRESS when the execution thread
+        unwinds used to land in no bucket; they must be counted as failed so
+        completed + dead + aborted + failed == total."""
+
+        class ExplodingBackend(FakeClusterBackend):
+            def list_partition_reassignments(self):
+                raise ValueError("metadata fetch exploded")
+
+        backend = ExplodingBackend()
+        for b in range(4):
+            backend.add_broker(b, rack=str(b % 2))
+        for p in range(3):
+            backend.create_partition(("T", p), [p % 4, (p + 1) % 4], load=[1.0] * 4)
+        executor = Executor(backend, progress_check_interval_s=0.01)
+        proposals = [move_proposal(("T", 0), [0, 1], [2, 1])]
+        summary = executor.execute_proposals(proposals)
+        assert summary.error is not None and "ValueError" in summary.error
+        assert not summary.succeeded
+        tasks = executor._planner.all_tasks
+        assert summary.total == len(tasks)
+        assert summary.failed >= 1      # the in-flight move when the error hit
+        # executor is reusable after the degraded run
+        assert executor.state == "NO_TASK_IN_PROGRESS"
+        assert not executor.has_ongoing_execution
+
+    def test_cleanup_steps_run_independently(self):
+        """One failing cleanup step (resume callback) must not skip the rest:
+        throttles still cleared, summary still produced, notifier still told."""
+        backend = make_backend()
+        finished = []
+
+        class Note(ExecutorNotifier):
+            def on_execution_finished(self, summary):
+                finished.append(summary)
+
+        def bad_resume(reason):
+            raise RuntimeError("monitor is gone")
+
+        executor = Executor(
+            backend,
+            throttle_rate_bytes=1e6,
+            notifier=Note(),
+            pause_sampling=lambda r: None,
+            resume_sampling=bad_resume,
+        )
+        summary = executor.execute_proposals([move_proposal(("T", 0), [0, 1], [2, 1])])
+        assert summary.succeeded
+        assert backend.current_throttle is None
+        assert finished == [summary]
 
     def test_dead_destination_marks_task_dead(self):
         backend = make_backend(latency=3)
