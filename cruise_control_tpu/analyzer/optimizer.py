@@ -26,7 +26,7 @@ import dataclasses
 import os
 import time
 from functools import partial
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -117,6 +117,10 @@ class ProvisionRecommendation:
     message: str
     num_brokers_to_add: int = 0
     num_brokers_to_remove: int = 0
+    #: capacity-sweep evidence (sim/planner.py): scenario/dispatch counts and
+    #: the measured minimum broker count.  None when no sweep backs the number
+    #: — the provisioner downgrades such recommendations to its placeholder.
+    sweep: Optional[Dict[str, object]] = None
 
 
 #: AnalyzerConfig.java defaults: overprovisioned.min.brokers (:*),

@@ -41,6 +41,44 @@ _ASYNC_PARAMS = [
      "description": "approved two-step-verification request to execute"},
 ]
 
+#: endpoint-specific query parameters beyond the common/async sets
+_ENDPOINT_PARAMS = {
+    "SIMULATE": [
+        {"name": "scenarios", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": ("JSON list of scenario specs (sim.scenario.Scenario "
+                         "wire format: add_brokers, remove_brokers, "
+                         "kill_brokers, drop_rack, load_factor, "
+                         "topic_load_factors, capacity_factors, goal_order)")},
+        {"name": "add_broker_counts", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": "shorthand sweep: comma-separated added-broker counts"},
+        {"name": "load_factors", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": "shorthand sweep: comma-separated load multipliers"},
+        {"name": "remove_brokerid", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": "brokers decommissioned in every shorthand scenario"},
+        {"name": "kill_brokerid", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": "brokers failed in every shorthand scenario"},
+        {"name": "drop_rack", "in": "query", "required": False,
+         "schema": {"type": "integer"},
+         "description": "rack whose brokers all fail in every shorthand scenario"},
+        {"name": "deep", "in": "query", "required": False,
+         "schema": {"type": "boolean"},
+         "description": "run the full goal optimizer per scenario"},
+    ],
+    "RIGHTSIZE": [
+        {"name": "load_factor", "in": "query", "required": False,
+         "schema": {"type": "number"},
+         "description": "plan capacity for current load × this factor"},
+        {"name": "broker_number", "in": "query", "required": False,
+         "schema": {"type": "integer"},
+         "description": "cap on extra brokers the capacity sweep may probe"},
+    ],
+}
+
 
 def _schema_to_openapi(schema: Any) -> Dict[str, Any]:
     """Translate the schemas.py mini-language into an OpenAPI schema object."""
@@ -117,6 +155,7 @@ def generate_openapi() -> Dict[str, Any]:
                     "; may instead return a pending review entry when "
                     "two-step verification is enabled"
                 )
+        params = params + _ENDPOINT_PARAMS.get(name, [])
         op = {
             "operationId": name.lower(),
             "summary": name,

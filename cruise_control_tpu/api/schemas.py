@@ -123,6 +123,45 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
         "?balancedness": (float, None),
     },
     "KAFKA_CLUSTER_STATE": {"brokers": [dict], "topics": dict},
+    "SIMULATE": {
+        "sweep": {
+            "size": int,
+            "bucketBrokers": int,
+            "numDispatches": int,
+            "bucketHit": bool,
+            "durationS": float,
+            "deep": bool,
+        },
+        "scenarios": [
+            {
+                "name": str,
+                "verdict": str,
+                "violations": dict,
+                "hard_violations": float,
+                "violated_hard_goals": [str],
+                "balancedness": float,
+                "satisfiable": bool,
+                "min_brokers_needed": int,
+                "offline_moves": int,
+                "offline_data_to_move": float,
+                "?movement": (dict, None),
+                "?provision_status": (str, None),
+            }
+        ],
+    },
+    "RIGHTSIZE": {
+        "state": str,
+        "summary": str,
+        "?plan": {
+            "minBrokers": (int, None),
+            "currentBrokers": int,
+            "loadFactor": float,
+            "numDispatches": int,
+            "durationS": float,
+            "probes": [dict],
+            "recommendation": dict,
+        },
+    },
     "USER_TASKS": {"userTasks": [_USER_TASK]},
     "REVIEW_BOARD": {"requestInfo": [dict]},
     "PERMISSIONS": {"role": str},

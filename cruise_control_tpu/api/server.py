@@ -7,7 +7,11 @@ GET  STATE LOAD PARTITION_LOAD PROPOSALS KAFKA_CLUSTER_STATE USER_TASKS
      REVIEW_BOARD PERMISSIONS BOOTSTRAP TRAIN TRACES
 POST REBALANCE ADD_BROKER REMOVE_BROKER DEMOTE_BROKER FIX_OFFLINE_REPLICAS
      STOP_PROPOSAL_EXECUTION PAUSE_SAMPLING RESUME_SAMPLING TOPIC_CONFIGURATION
-     RIGHTSIZE REMOVE_DISKS ADMIN REVIEW
+     RIGHTSIZE REMOVE_DISKS ADMIN REVIEW SIMULATE
+
+SIMULATE (no reference counterpart) evaluates a batch of hypothetical clusters
+— broker adds/removals/failures, rack loss, load and capacity scaling — in one
+device dispatch (``sim/``); RIGHTSIZE runs the sweep-backed capacity planner.
 
 Long-running POSTs flow through the :class:`UserTaskManager` (202 + ``User-Task-ID``
 until done), optionally parked in the :class:`Purgatory` when two-step verification
@@ -50,10 +54,11 @@ POST_ENDPOINTS = {
     "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
     "FIX_OFFLINE_REPLICAS", "STOP_PROPOSAL_EXECUTION", "PAUSE_SAMPLING",
     "RESUME_SAMPLING", "TOPIC_CONFIGURATION", "RIGHTSIZE", "REMOVE_DISKS",
-    "ADMIN", "REVIEW",
+    "ADMIN", "REVIEW", "SIMULATE",
 }
 #: POSTs that change cluster state and thus go through two-step verification
-REVIEWABLE = POST_ENDPOINTS - {"REVIEW"}
+#: (SIMULATE is a pure what-if evaluation — nothing to review)
+REVIEWABLE = POST_ENDPOINTS - {"REVIEW", "SIMULATE"}
 
 
 def _qbool(params: Dict[str, List[str]], name: str, default: bool) -> bool:
@@ -336,21 +341,24 @@ class CruiseControlApp:
 
     # -- POST handlers -------------------------------------------------------
 
-    def _async_op(self, endpoint: str, params, work) -> Tuple[int, dict, Dict[str, str]]:
+    def _async_op(
+        self, endpoint: str, params, work, to_json=_op_result_json
+    ) -> Tuple[int, dict, Dict[str, str]]:
         key = (endpoint, tuple(sorted((k, tuple(v)) for k, v in params.items())))
         task = self.user_tasks.get_or_create(endpoint, key, work)
+        task.result_to_json = to_json   # USER_TASKS serves the final body
         headers = {"User-Task-ID": task.task_id}
         if task.status in (TaskStatus.COMPLETED, TaskStatus.COMPLETED_WITH_ERROR):
             try:
                 result = task.future.result(timeout=0)
-                return 200, _op_result_json(result), headers
+                return 200, to_json(result), headers
             except Exception as e:
                 return 500, {"error": str(e), "progress": task.progress.to_list()}, headers
         # wait briefly so fast operations answer synchronously (reference's
         # session wait inside getOrCreateUserTask)
         try:
             result = task.future.result(timeout=1.0)
-            return 200, _op_result_json(result), headers
+            return 200, to_json(result), headers
         except Exception:
             pass
         return 202, {"progress": task.progress.to_list(), "userTaskId": task.task_id}, headers
@@ -421,21 +429,78 @@ class CruiseControlApp:
         self.cc.resume_sampling(reason)
         return 200, {"message": f"Sampling resumed: {reason}"}, {}
 
+    def post_simulate(self, params):
+        """SIMULATE: batched what-if evaluation (sim/ — no reference analogue).
+
+        ``scenarios`` carries a JSON list of scenario specs
+        (``sim.scenario.Scenario.from_dict``); without it, the shorthand
+        parameters build a capacity cross-product sweep:
+        ``add_broker_counts`` × ``load_factors``, each scenario also applying
+        ``remove_brokerid``/``kill_brokerid``/``drop_rack``.  ``deep=true``
+        runs the full optimizer per scenario instead of the single-dispatch
+        as-is evaluation."""
+        from cruise_control_tpu.sim.scenario import Scenario
+
+        deep = _qbool(params, "deep", False)
+        goal_ids = _goal_ids(params)
+        raw = params.get("scenarios", [None])[0]
+        if raw:
+            specs = json.loads(raw)
+            if not isinstance(specs, list):
+                raise ValueError("scenarios must be a JSON list")
+            scenarios = [Scenario.from_dict(d) for d in specs]
+        else:
+            adds = _qint_list(params, "add_broker_counts") or [0]
+            lf_raw = params.get("load_factors", [None])[0]
+            factors = [float(x) for x in lf_raw.split(",")] if lf_raw else [1.0]
+            removes = tuple(_qint_list(params, "remove_brokerid"))
+            kills = tuple(_qint_list(params, "kill_brokerid"))
+            drop_rack = params.get("drop_rack", [None])[0]
+            scenarios = [
+                Scenario(
+                    name=f"add={a},load={f:g}",
+                    add_brokers=a,
+                    remove_brokers=removes,
+                    kill_brokers=kills,
+                    drop_rack=None if drop_rack is None else int(drop_rack),
+                    load_factor=f,
+                )
+                for f in factors
+                for a in adds
+            ]
+
+        def work(progress):
+            progress.add_step("WaitingForClusterModel")
+            progress.add_step("ScenarioSweep")
+            return self.cc.simulate(scenarios, deep=deep, goal_ids=goal_ids)
+
+        return self._async_op(
+            "SIMULATE", params, work, to_json=lambda r: r.to_dict()
+        )
+
     def post_rightsize(self, params):
+        """RIGHTSIZE: run the batched capacity planner and hand its
+        sweep-backed recommendation to the provisioner — the verdict carries
+        measured numbers (sim/planner.py), not the reference's placeholder."""
         if self.provisioner is None:
             return 400, {"error": "no provisioner configured"}, {}
-        from cruise_control_tpu.analyzer.optimizer import ProvisionRecommendation
+        load_factor = float(params.get("load_factor", ["1.0"])[0])
+        extra = params.get("broker_number", [None])[0]
 
-        rec = ProvisionRecommendation(
-            status="UNDER_PROVISIONED",
-            violated_hard_goals=[],
-            message=(
-                f"operator rightsize request: brokers+={params.get('broker_number', ['0'])[0]} "
-                f"partitions={params.get('partition_count', ['-'])[0]}"
-            ),
-        )
-        result = self.provisioner.rightsize(rec)
-        return 200, {"state": result.state.value, "summary": result.summary}, {}
+        def work(progress):
+            progress.add_step("CapacitySweep")
+            plan = self.cc.plan_capacity(
+                load_factor=load_factor,
+                max_extra_brokers=int(extra) if extra else None,
+            )
+            result = self.provisioner.rightsize(plan.recommendation)
+            return {
+                "state": result.state.value,
+                "summary": result.summary,
+                "plan": plan.to_dict(),
+            }
+
+        return self._async_op("RIGHTSIZE", params, work, to_json=lambda r: r)
 
     def post_remove_disks(self, params):
         spec = params.get("brokerid_and_logdirs", [""])[0]

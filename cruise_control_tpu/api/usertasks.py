@@ -37,15 +37,25 @@ class UserTask:
     future: Future
     created_ms: int
     status: TaskStatus = TaskStatus.ACTIVE
+    #: response formatter installed by the API layer; lets USER_TASKS serve a
+    #: completed task's final body, so clients never have to re-issue the
+    #: original (possibly mutating) request just to read the result
+    result_to_json: Optional[Callable[[object], dict]] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "UserTaskId": self.task_id,
             "RequestURL": self.endpoint,
             "Status": self.status.value,
             "StartMs": self.created_ms,
             "Progress": self.progress.to_list(),
         }
+        if self.status is TaskStatus.COMPLETED and self.result_to_json is not None:
+            try:
+                d["result"] = self.result_to_json(self.future.result(timeout=0))
+            except Exception:
+                pass  # formatting must not break the task listing
+        return d
 
 
 class UserTaskManager:

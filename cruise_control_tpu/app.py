@@ -234,6 +234,13 @@ class CruiseControlTpuApp:
                     provisioner=(
                         self.provisioner if cfg.get("provisioner.enable") else None
                     ),
+                    # capacity sweeps (sim/planner.py) back every rightsize
+                    # with measured numbers instead of the single-model guess
+                    planner=(
+                        self.cruise_control.plan_capacity
+                        if cfg.get("provisioner.enable")
+                        else None
+                    ),
                 ),
                 _iv("goal.violation.detection.interval.ms"),
             ),

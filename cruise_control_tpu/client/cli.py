@@ -43,6 +43,9 @@ def build_parser() -> argparse.ArgumentParser:
         if name == "rebalance":
             p.add_argument("--goals", default=None, help="comma-separated goal names")
             p.add_argument("--excluded-topics", default=None)
+        if name == "rightsize":
+            p.add_argument("--load-factor", type=float, default=None,
+                           help="plan capacity for current load × this factor")
 
     for name in ("add_broker", "remove_broker", "demote_broker"):
         p = sub.add_parser(name)
@@ -70,6 +73,26 @@ def build_parser() -> argparse.ArgumentParser:
     rv.add_argument("--approve", default=None, help="comma-separated review ids")
     rv.add_argument("--discard", default=None, help="comma-separated review ids")
     rv.add_argument("--reason", default=None)
+
+    sm = sub.add_parser(
+        "simulate",
+        help="batched what-if sweep: hypothetical broker/load/capacity changes",
+    )
+    sm.add_argument("--scenarios-json", default=None,
+                    help="JSON list of scenario specs (full Scenario wire format)")
+    sm.add_argument("--add-broker-counts", default=None,
+                    help="comma-separated added-broker counts to sweep")
+    sm.add_argument("--load-factors", default=None,
+                    help="comma-separated global load multipliers to sweep")
+    sm.add_argument("--remove-brokers", default=None,
+                    help="comma-separated broker ids to decommission in every scenario")
+    sm.add_argument("--kill-brokers", default=None,
+                    help="comma-separated broker ids to fail in every scenario")
+    sm.add_argument("--drop-rack", type=int, default=None,
+                    help="rack id whose brokers all fail in every scenario")
+    sm.add_argument("--deep", action="store_true",
+                    help="run the full goal optimizer per scenario")
+    sm.add_argument("--goals", default=None, help="comma-separated goal names")
     return ap
 
 
@@ -93,7 +116,19 @@ def main(argv=None) -> int:
         elif ep == "fix_offline_replicas":
             out = client.fix_offline_replicas(dryrun=args.dryrun, wait=wait)
         elif ep == "rightsize":
-            out = client.rightsize(dryrun=args.dryrun, wait=wait)
+            out = client.rightsize(dryrun=args.dryrun, load_factor=args.load_factor, wait=wait)
+        elif ep == "simulate":
+            out = client.simulate(
+                scenarios=json.loads(args.scenarios_json) if args.scenarios_json else None,
+                add_broker_counts=_int_list(args.add_broker_counts) if args.add_broker_counts else None,
+                load_factors=[float(x) for x in args.load_factors.split(",")] if args.load_factors else None,
+                remove_brokers=_int_list(args.remove_brokers) if args.remove_brokers else None,
+                kill_brokers=_int_list(args.kill_brokers) if args.kill_brokers else None,
+                drop_rack=args.drop_rack,
+                deep=args.deep,
+                goals=args.goals.split(",") if args.goals else None,
+                wait=wait,
+            )
         elif ep == "topic_configuration":
             out = client.topic_configuration(args.topic, args.replication_factor,
                                              dryrun=args.dryrun, wait=wait)

@@ -88,11 +88,16 @@ class CruiseControlClient:
             task_id = headers.get("User-Task-ID") or body.get("userTaskId")
             if not wait:
                 return {"userTaskId": task_id, "accepted": True}
-            return self._await_task(task_id)
+            return self._await_task(endpoint, params, task_id)
         return body
 
-    def _await_task(self, task_id: str) -> Any:
-        """Poll USER_TASKS until the task completes (Responder's retry loop)."""
+    def _await_task(self, endpoint: str, params: Dict[str, Any], task_id: str) -> Any:
+        """Poll USER_TASKS until the task completes (Responder's retry loop).
+
+        The server embeds the completed task's final response body as
+        ``result`` — never re-issue the original request to fetch it, a
+        re-POST could re-execute a mutating operation if the completed task
+        was already evicted from the server's task map."""
         deadline = time.monotonic() + self.poll_timeout_s
         while time.monotonic() < deadline:
             body = self._get("user_tasks", user_task_ids=task_id)
@@ -192,8 +197,45 @@ class CruiseControlClient:
             replication_factor=replication_factor, dryrun=str(dryrun).lower(),
         )
 
-    def rightsize(self, dryrun: bool = True, wait: bool = True) -> Any:
-        return self._post("rightsize", wait=wait, dryrun=str(dryrun).lower())
+    def rightsize(
+        self,
+        dryrun: bool = True,
+        load_factor: Optional[float] = None,
+        wait: bool = True,
+    ) -> Any:
+        return self._post(
+            "rightsize", wait=wait, dryrun=str(dryrun).lower(),
+            load_factor=load_factor,
+        )
+
+    def simulate(
+        self,
+        scenarios: Optional[Sequence[Dict[str, Any]]] = None,
+        add_broker_counts: Optional[Sequence[int]] = None,
+        load_factors: Optional[Sequence[float]] = None,
+        remove_brokers: Optional[Sequence[int]] = None,
+        kill_brokers: Optional[Sequence[int]] = None,
+        drop_rack: Optional[int] = None,
+        deep: bool = False,
+        goals: Optional[Sequence[str]] = None,
+        wait: bool = True,
+    ) -> Any:
+        """POST /simulate: batched what-if sweep (sim/ subsystem).
+
+        ``scenarios`` is a list of scenario dicts (the Scenario wire format);
+        the shorthand arguments instead build an add-brokers × load-factor
+        cross product, each scenario also applying the removals/failures."""
+        return self._post(
+            "simulate", wait=wait,
+            scenarios=json.dumps(scenarios) if scenarios is not None else None,
+            add_broker_counts=self._csv(add_broker_counts),
+            load_factors=self._csv(load_factors),
+            remove_brokerid=self._csv(remove_brokers),
+            kill_brokerid=self._csv(kill_brokers),
+            drop_rack=drop_rack,
+            deep=str(deep).lower(),
+            goals=self._csv(goals),
+        )
 
     def remove_disks(
         self, broker_id_and_logdirs: Sequence[Tuple[int, str]], dryrun: bool = True,

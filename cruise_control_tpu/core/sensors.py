@@ -195,3 +195,9 @@ CHAOS_FAULTS_COUNTER = "ChaosBackend.faults-injected"
 FETCHER_REPLACED_COUNTER = "MetricFetcherManager.hung-fetchers-replaced"
 FLIGHT_TRACES_COUNTER = "FlightRecorder.traces-recorded"
 FLIGHT_RING_GAUGE = "FlightRecorder.ring-size"
+SIM_SWEEPS_COUNTER = "ScenarioPlanner.sweeps"
+SIM_SCENARIOS_COUNTER = "ScenarioPlanner.scenarios-evaluated"
+SIM_BUCKET_HITS_COUNTER = "ScenarioPlanner.bucket-hits"
+SIM_BUCKET_MISSES_COUNTER = "ScenarioPlanner.bucket-misses"
+SIM_SWEEP_TIMER = "ScenarioPlanner.sweep-timer"
+PLANNER_FAILURES_COUNTER = "GoalViolationDetector.planner-failures"

@@ -60,6 +60,7 @@ class GoalViolationDetector(Detector):
         cruise_control,
         detection_goal_ids: Sequence[int] = G.DEFAULT_GOAL_ORDER,
         provisioner=None,
+        planner=None,
     ) -> None:
         self.cc = cruise_control
         self.detection_goal_ids = tuple(detection_goal_ids)
@@ -69,6 +70,13 @@ class GoalViolationDetector(Detector):
         #: (GoalViolationDetector.java:227 rightsize hook)
         self.provisioner = provisioner
         self.last_provisioner_result = None
+        #: optional zero-arg capacity planner (facade.plan_capacity) run before
+        #: rightsize so the recommendation carries sweep-backed numbers instead
+        #: of the optimizer's single-model heuristic
+        self.planner = planner
+        #: last planner exception (also counted by the
+        #: GoalViolationDetector.planner-failures sensor)
+        self.last_planner_error: Optional[Exception] = None
 
     def run(self) -> List[Anomaly]:
         try:
@@ -94,7 +102,26 @@ class GoalViolationDetector(Detector):
 
         REGISTRY.gauge(BALANCEDNESS_GAUGE).set(self.balancedness_score)
         if self.provisioner is not None and result.provision.status != "RIGHT_SIZED":
-            self.last_provisioner_result = self.provisioner.rightsize(result.provision)
+            rec = result.provision
+            if self.planner is not None:
+                try:
+                    plan = self.planner()
+                    # the sweep-backed recommendation carries measured numbers;
+                    # keep the optimizer's violated-goal list (the sweep has no
+                    # notion of which goal refused)
+                    plan.recommendation.violated_hard_goals = rec.violated_hard_goals
+                    rec = plan.recommendation
+                except Exception as e:
+                    # planner failure must not break detection, but it must be
+                    # visible — a systematic crash here silently downgrades
+                    # every rightsize to the unquantified placeholder
+                    from cruise_control_tpu.core.sensors import (
+                        PLANNER_FAILURES_COUNTER,
+                    )
+
+                    REGISTRY.counter(PLANNER_FAILURES_COUNTER).inc()
+                    self.last_planner_error = e
+            self.last_provisioner_result = self.provisioner.rightsize(rec)
         violated = [
             name for name, v in result.violations_before.items() if v > 0
         ]

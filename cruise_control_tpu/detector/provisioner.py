@@ -42,13 +42,35 @@ class NoopProvisioner(Provisioner):
 
 class BasicProvisioner(Provisioner):
     """Records recommendations; actual broker/disk changes are out of scope
-    (BasicProvisioner.java behaves the same way)."""
+    (BasicProvisioner.java behaves the same way).
+
+    A recommendation backed by a capacity sweep (``recommendation.sweep``
+    populated by ``sim/planner.py``) completes with the concrete broker count
+    — there is real data behind the number.  Without a sweep the reference's
+    placeholder ``COMPLETED_WITH_ERROR`` stands: the recommendation is an
+    unquantified guess the operator must validate."""
 
     def __init__(self) -> None:
         self.history: List[ProvisionRecommendation] = []
 
     def rightsize(self, recommendation) -> ProvisionerResult:
         self.history.append(recommendation)
+        sweep = getattr(recommendation, "sweep", None)
+        if sweep:
+            delta = (
+                f"+{recommendation.num_brokers_to_add}"
+                if recommendation.num_brokers_to_add
+                else f"-{recommendation.num_brokers_to_remove}"
+                if recommendation.num_brokers_to_remove
+                else "±0"
+            )
+            return ProvisionerResult(
+                ProvisionerState.COMPLETED,
+                f"sweep-backed {recommendation.status} ({delta} brokers, "
+                f"{sweep.get('scenarios_evaluated', '?')} scenarios in "
+                f"{sweep.get('num_dispatches', '?')} dispatches): "
+                f"{recommendation.message}",
+            )
         return ProvisionerResult(
             ProvisionerState.COMPLETED_WITH_ERROR,
             f"recorded recommendation: {recommendation.message}",

@@ -291,6 +291,58 @@ class CruiseControl:
         )
         return OperationResult(empty, execution, dryrun)
 
+    def simulate(
+        self,
+        scenarios: Sequence["Scenario"],
+        deep: bool = False,
+        goal_ids: Optional[Sequence[int]] = None,
+        mesh=None,
+    ) -> "SweepResult":
+        """Evaluate hypothetical clusters (the SIMULATE endpoint substrate).
+
+        ``deep=False``: all scenarios in one batched device dispatch
+        (``sim.batch.fast_sweep``) — as-is violations, balancedness,
+        satisfiability, movement floor.  ``deep=True``: a full
+        ``GoalOptimizer.optimize`` per scenario (``sim.batch.deep_sweep``) —
+        post-rebalance verdicts and the real movement bill.  ``mesh`` shards
+        the fast path's scenario axis over a device mesh."""
+        from cruise_control_tpu.sim import batch as sim_batch
+
+        model = self.cluster_model()
+        state, _ = model.to_arrays()
+        gids = tuple(goal_ids) if goal_ids is not None else self.goal_ids
+        kw = dict(
+            constraint=self.constraint,
+            goal_ids=gids,
+            hard_ids=tuple(g for g in self.hard_ids if g in gids) or self.hard_ids,
+            enable_heavy=False,
+        )
+        if deep:
+            return sim_batch.deep_sweep(state, scenarios, **kw)
+        return sim_batch.fast_sweep(state, scenarios, mesh=mesh, **kw)
+
+    def plan_capacity(
+        self,
+        load_factor: float = 1.0,
+        goal_ids: Optional[Sequence[int]] = None,
+        max_extra_brokers: Optional[int] = None,
+    ) -> "CapacityPlan":
+        """Batched-bisection capacity plan (the RIGHTSIZE substrate): minimum
+        brokers such that every hard goal is satisfiable under load × f."""
+        from cruise_control_tpu.sim.planner import plan_capacity as _plan
+
+        model = self.cluster_model()
+        state, _ = model.to_arrays()
+        gids = tuple(goal_ids) if goal_ids is not None else self.goal_ids
+        return _plan(
+            state,
+            constraint=self.constraint,
+            load_factor=load_factor,
+            goal_ids=gids,
+            hard_ids=tuple(g for g in self.hard_ids if g in gids) or self.hard_ids,
+            max_extra_brokers=max_extra_brokers,
+        )
+
     def train_cpu_model(self, from_ms: int = 0, to_ms: Optional[int] = None) -> bool:
         """GET /train: fit the linear CPU model from broker metric history.
 

@@ -28,7 +28,11 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:  # jax ≥ 0.4.35 exports shard_map from jax.experimental; newer jax from jax
+    from jax.experimental.shard_map import shard_map
+except ImportError:  # pragma: no cover - exercised only on newer jax
+    from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cruise_control_tpu.parallel.mesh import REPLICA_AXIS

@@ -1,0 +1,51 @@
+"""What-if scenario planner: batched hypothetical-cluster evaluation.
+
+The reference answers exactly one hypothetical per request — an
+``ADD_BROKER``/``REMOVE_BROKER`` dryrun walks the whole sequential analyzer
+for a single mutated ``ClusterModel``.  The TPU reframing makes the missing
+capability cheap: a :class:`~cruise_control_tpu.sim.scenario.Scenario` is a
+declarative edit of the base :class:`ClusterArrays` (add/remove/kill brokers,
+drop a rack, scale load, change capacities), a batch of scenarios becomes ONE
+stacked pytree padded to a common bucketed broker dimension, and
+``jax.vmap`` evaluates every hypothetical cluster in a single device dispatch
+(``sim.batch``).  ``sim.planner`` bisects broker count over that batched
+evaluator to answer "minimum brokers such that all hard goals are satisfiable
+under load × f" with real numbers behind the provisioning verdict.
+
+Layers:
+
+* :mod:`sim.scenario` — the declarative spec + padded, bucketed batch builder;
+* :mod:`sim.batch`    — single-dispatch fast sweep (violations/balancedness/
+  movement floor/satisfiability) and the deep per-scenario ``optimize()`` path;
+* :mod:`sim.planner`  — capacity bisection returning a populated
+  :class:`ProvisionRecommendation`.
+"""
+
+from cruise_control_tpu.sim.scenario import (
+    Scenario,
+    ScenarioBatch,
+    apply_scenario,
+    broker_bucket,
+    build_batch,
+)
+from cruise_control_tpu.sim.batch import (
+    ScenarioVerdict,
+    SweepResult,
+    deep_sweep,
+    fast_sweep,
+)
+from cruise_control_tpu.sim.planner import CapacityPlan, plan_capacity
+
+__all__ = [
+    "CapacityPlan",
+    "Scenario",
+    "ScenarioBatch",
+    "ScenarioVerdict",
+    "SweepResult",
+    "apply_scenario",
+    "broker_bucket",
+    "build_batch",
+    "deep_sweep",
+    "fast_sweep",
+    "plan_capacity",
+]

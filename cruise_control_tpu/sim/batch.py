@@ -1,0 +1,493 @@
+"""Batched scenario evaluation: B hypothetical clusters, one device dispatch.
+
+Two evaluation depths over a :class:`~cruise_control_tpu.sim.scenario.ScenarioBatch`:
+
+* :func:`fast_sweep` — the whole batch in ONE compiled dispatch: ``jax.vmap``
+  lifts the existing per-cluster evaluators (``take_snapshot`` +
+  ``goals_base.violations_all``) over the scenario axis, alongside a
+  vectorized hard-goal *satisfiability* kernel (the necessary conditions of
+  ``provision_verdict``: capacity totals, replica-count caps, replication
+  factor vs alive brokers/racks) and a movement-cost floor (offline replicas
+  that must relocate).  This is the CvxCluster batch-allocation move: one
+  program evaluates hundreds of hypothetical clusters for the price of the
+  dispatch overhead of one.
+* :func:`deep_sweep` — one full ``GoalOptimizer.optimize`` per scenario (the
+  sequential-by-construction lexicographic goal walk cannot vmap), but every
+  scenario shares the bucketed broker shape, so the compiled goal programs are
+  reused across the whole sweep — repeated capacity questions pay zero
+  recompile (the Execution-Templates caching argument).
+
+Dispatch accounting mirrors ``analyzer/optimizer.py``: ``fast_sweep`` enqueues
+exactly one jitted computation (the bulk ``device_get`` fetch is not a
+dispatch), ``deep_sweep`` sums the per-optimize counts.  Every sweep emits an
+obs flight-recorder trace (kind ``"simulate"``) carrying sweep size, bucket
+shape, executable-cache hit/miss counts and — via the recorder's compile-event
+listener — any XLA compiles the sweep caused, so the ≤-2-dispatches-after-
+warmup contract is assertable from the trace alone.
+
+The scenario axis is shardable over the ``parallel/`` mesh: pass ``mesh=`` and
+the batch is laid out scenario-data-parallel (each device evaluates S/n
+scenarios; per-scenario results need no cross-device communication at all).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.context import GoalContext, take_snapshot
+from cruise_control_tpu.analyzer.optimizer import (
+    MAX_BALANCEDNESS_SCORE,
+    balancedness_cost_by_goal,
+)
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.model.arrays import ClusterArrays
+from cruise_control_tpu.ops.segments import segment_sum as _segment_sum
+from cruise_control_tpu.sim.scenario import Scenario, ScenarioBatch, build_batch
+
+_EPS = 1e-6
+
+
+# -- hard-goal satisfiability (vectorized provision_verdict core) -------------------
+
+
+def _hard_satisfiability(state: ClusterArrays, ctx: GoalContext):
+    """(satisfiable bool, min alive brokers needed i32) for ONE cluster.
+
+    Necessary conditions for the default hard goals to be satisfiable by SOME
+    placement (not the current one): total must-serve load fits under the
+    capacity thresholds of the alive brokers, replica counts fit under
+    ``max_replicas_per_broker``, and max replication factor does not exceed
+    alive brokers (ReplicaCapacity/**Capacity goals) or alive racks
+    (RackAwareGoal).  Uses the alive-mean per-broker capacity like
+    ``provision_verdict`` — heterogeneous-capacity clusters get the same
+    approximation the reference's provision stream makes.
+    """
+    valid = state.replica_valid
+    alive = state.broker_alive
+    n_alive = jnp.maximum(alive.sum(), 1)
+
+    # must-serve load: every valid replica's follower-equivalent base, plus
+    # each still-replicated partition's leadership delta exactly once —
+    # placement-independent, so it prices the post-rebalance cluster
+    rf = _segment_sum(
+        valid.astype(jnp.int32), state.replica_partition,
+        num_segments=state.num_partitions,
+    )
+    total = jnp.where(valid[:, None], state.base_load, 0.0).sum(axis=0)
+    total = total + jnp.where((rf > 0)[:, None], state.leadership_delta, 0.0).sum(axis=0)
+
+    thr = ctx.constraint.resource_capacity_threshold
+    usable = (jnp.where(alive[:, None], state.broker_capacity, 0.0) * thr[None, :]).sum(axis=0)
+    cap_ok = jnp.all(total <= usable * (1 + _EPS) + _EPS)
+
+    per_broker = usable / n_alive.astype(jnp.float32)
+    needed_by_res = jnp.ceil(
+        (total / jnp.maximum(per_broker, 1e-9)).max()
+    ).astype(jnp.int32)
+
+    n_replicas = valid.sum()
+    max_per_broker = ctx.constraint.max_replicas_per_broker
+    count_ok = n_replicas <= n_alive * max_per_broker
+    needed_by_count = jnp.ceil(
+        n_replicas.astype(jnp.float32) / jnp.maximum(max_per_broker, 1).astype(jnp.float32)
+    ).astype(jnp.int32)
+
+    rf_max = rf.max()
+    rf_ok = rf_max <= n_alive
+    alive_racks = jax.ops.segment_max(
+        alive.astype(jnp.int32), state.broker_rack, num_segments=state.num_racks
+    ).sum()
+    rack_ok = rf_max <= alive_racks
+
+    sat = cap_ok & count_ok & rf_ok & rack_ok
+    needed = jnp.maximum(jnp.maximum(needed_by_res, needed_by_count), rf_max)
+    return sat, needed
+
+
+@partial(jax.jit, static_argnames=("enable_heavy", "subset"))
+def _sweep_kernel(states, ctx, enable_heavy=False, subset=None):
+    """ONE dispatch: per-scenario violations + satisfiability + movement floor."""
+
+    def one(state):
+        snap = take_snapshot(state, ctx, enable_heavy)
+        viol = G.violations_all(state, ctx, snap, subset=subset)
+        offline = state.replica_offline_mask()
+        n_off = offline.sum().astype(jnp.int32)
+        off_bytes = jnp.where(offline, state.base_load[:, Resource.DISK], 0.0).sum()
+        sat, needed = _hard_satisfiability(state, ctx)
+        return viol, sat, needed, n_off, off_bytes
+
+    return jax.vmap(one)(states)
+
+
+# -- executable-shape accounting ----------------------------------------------------
+#
+# jax's jit cache already guarantees shape-bucketed sweeps never recompile;
+# this bookkeeping makes the guarantee OBSERVABLE: a sweep whose shape key was
+# seen before is a bucket hit (warm executable), a new key is a miss (compile).
+# Counters land in the sensor registry and on every simulate trace.
+
+_SHAPE_LOCK = threading.Lock()
+_SEEN_SHAPES: set = set()
+
+
+def _shape_key(batch: ScenarioBatch, subset, enable_heavy, sharded: bool) -> tuple:
+    return (
+        batch.size,
+        batch.bucket,
+        int(batch.states.disk_broker.shape[-1]),  # leaves are [S, ...]-stacked
+        subset,
+        enable_heavy,
+        sharded,
+    )
+
+
+def _note_shape(key: tuple) -> bool:
+    """Record the sweep shape; True = warm bucket hit, False = fresh compile."""
+    from cruise_control_tpu.core.sensors import (
+        REGISTRY,
+        SIM_BUCKET_HITS_COUNTER,
+        SIM_BUCKET_MISSES_COUNTER,
+    )
+
+    with _SHAPE_LOCK:
+        hit = key in _SEEN_SHAPES
+        _SEEN_SHAPES.add(key)
+    REGISTRY.counter(
+        SIM_BUCKET_HITS_COUNTER if hit else SIM_BUCKET_MISSES_COUNTER
+    ).inc()
+    return hit
+
+
+# -- results ------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScenarioVerdict:
+    """Per-scenario outcome of a sweep."""
+
+    name: str
+    #: per-goal violating-entity counts of the hypothetical cluster AS-IS
+    #: (fast path) or AFTER optimization (deep path)
+    violations: Dict[str, float]
+    hard_violations: float
+    violated_hard_goals: List[str]
+    balancedness: float
+    #: whether SOME placement can satisfy every hard goal (fast-path
+    #: necessary-conditions kernel; deep path: no residual hard violations)
+    satisfiable: bool
+    #: minimum alive brokers implied by the most constrained resource
+    min_brokers_needed: int
+    #: movement floor: replicas that MUST relocate (offline) and their disk data
+    offline_moves: int
+    offline_data_to_move: float
+    #: deep path only: the full movement bill of the optimized plan
+    movement: Optional[Dict[str, float]] = None
+    provision_status: Optional[str] = None
+
+    @property
+    def verdict(self) -> str:
+        if self.hard_violations > 0:
+            return "HARD_VIOLATED" if self.satisfiable else "UNSATISFIABLE"
+        return "OK" if self.satisfiable else "UNSATISFIABLE"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["verdict"] = self.verdict
+        return d
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """Outcome of one batched sweep (fast or deep)."""
+
+    scenarios: List[ScenarioVerdict]
+    sweep_size: int
+    bucket: Tuple[int, int, int]
+    #: jitted computations enqueued by this sweep (1 for the fast path)
+    num_dispatches: int
+    #: the sweep's (shape, subset) executable was already warm
+    bucket_hit: bool
+    duration_s: float
+    deep: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "sweep": {
+                "size": self.sweep_size,
+                "bucketBrokers": self.bucket[0],
+                "numDispatches": self.num_dispatches,
+                "bucketHit": self.bucket_hit,
+                "durationS": round(self.duration_s, 4),
+                "deep": self.deep,
+            },
+            "scenarios": [v.to_dict() for v in self.scenarios],
+        }
+
+
+def _verdicts(
+    batch: ScenarioBatch,
+    goal_ids: Tuple[int, ...],
+    hard_ids: Tuple[int, ...],
+    viol: np.ndarray,
+    sat: np.ndarray,
+    needed: np.ndarray,
+    n_off: np.ndarray,
+    off_bytes: np.ndarray,
+) -> List[ScenarioVerdict]:
+    costs = balancedness_cost_by_goal(list(goal_ids), set(hard_ids))
+    names = G.GOAL_NAMES
+    out: List[ScenarioVerdict] = []
+    for i, label in enumerate(batch.names):
+        per_goal = {names[g]: float(viol[i, g]) for g in goal_ids}
+        violated_hard = [
+            names[g] for g in hard_ids if g in goal_ids and viol[i, g] > 0
+        ]
+        score = MAX_BALANCEDNESS_SCORE - sum(
+            costs[g] for g in goal_ids if viol[i, g] > 0
+        )
+        out.append(
+            ScenarioVerdict(
+                name=label,
+                violations=per_goal,
+                hard_violations=float(sum(viol[i, g] for g in hard_ids if g in goal_ids)),
+                violated_hard_goals=violated_hard,
+                balancedness=float(score),
+                satisfiable=bool(sat[i]),
+                min_brokers_needed=int(needed[i]),
+                offline_moves=int(n_off[i]),
+                offline_data_to_move=float(off_bytes[i]),
+            )
+        )
+    return out
+
+
+# -- public sweeps ------------------------------------------------------------------
+
+
+def fast_sweep(
+    base: ClusterArrays,
+    scenarios: Sequence[Scenario],
+    constraint: Optional[BalancingConstraint] = None,
+    goal_ids: Sequence[int] = G.DEFAULT_GOAL_ORDER,
+    hard_ids: Sequence[int] = G.HARD_GOALS,
+    enable_heavy: bool = False,
+    bucket_brokers: Optional[int] = None,
+    mesh=None,
+) -> SweepResult:
+    """Evaluate every scenario's cluster AS-IS in one compiled dispatch.
+
+    Returns per-scenario goal-violation counts (identical to evaluating each
+    mutated cluster directly — the batch is a layout, not an approximation),
+    balancedness, hard-goal satisfiability, the implied minimum broker count,
+    and the offline-movement floor.  ``mesh`` shards the scenario axis over
+    the device mesh (scenario-data-parallel; results are bit-equal to the
+    unsharded sweep)."""
+    from cruise_control_tpu.core.sensors import (
+        REGISTRY,
+        SIM_SCENARIOS_COUNTER,
+        SIM_SWEEPS_COUNTER,
+        SIM_SWEEP_TIMER,
+    )
+    from cruise_control_tpu.obs import recorder as obs
+
+    token = obs.start_trace("simulate")
+    t0 = time.monotonic()
+    goal_ids = tuple(goal_ids)
+    hard_ids = tuple(hard_ids)
+    batch = build_batch(base, scenarios, bucket_brokers=bucket_brokers)
+    ctx = GoalContext.build(
+        base.num_topics, batch.bucket[0], constraint=constraint
+    )
+    build_s = time.monotonic() - t0
+
+    states = batch.states
+    pad_s = 0
+    if mesh is not None:
+        states, ctx, pad_s = _shard_scenarios(states, ctx, mesh, batch.size)
+    key = _shape_key(batch, goal_ids, enable_heavy, mesh is not None)
+    hit = _note_shape(key)
+
+    t1 = time.monotonic()
+    viol, sat, needed, n_off, off_bytes = jax.device_get(
+        _sweep_kernel(states, ctx, enable_heavy=enable_heavy, subset=goal_ids)
+    )
+    if pad_s:
+        viol, sat, needed, n_off, off_bytes = (
+            a[: batch.size] for a in (viol, sat, needed, n_off, off_bytes)
+        )
+    sweep_s = time.monotonic() - t1
+
+    result = SweepResult(
+        scenarios=_verdicts(batch, goal_ids, hard_ids, viol, sat, needed, n_off, off_bytes),
+        sweep_size=batch.size,
+        bucket=batch.bucket,
+        num_dispatches=1,
+        bucket_hit=hit,
+        duration_s=time.monotonic() - t0,
+    )
+    REGISTRY.counter(SIM_SWEEPS_COUNTER).inc()
+    REGISTRY.counter(SIM_SCENARIOS_COUNTER).inc(batch.size)
+    REGISTRY.timer(SIM_SWEEP_TIMER).update(result.duration_s)
+    obs.finish_trace(
+        token,
+        spans=[
+            obs.Span("build-batch", "setup", build_s, 0),
+            obs.Span("sweep", "sweep", sweep_s, 1),
+        ],
+        attrs=_trace_attrs(result, goal_ids, mesh),
+    )
+    return result
+
+
+def deep_sweep(
+    base: ClusterArrays,
+    scenarios: Sequence[Scenario],
+    constraint: Optional[BalancingConstraint] = None,
+    goal_ids: Sequence[int] = G.DEFAULT_GOAL_ORDER,
+    hard_ids: Sequence[int] = G.HARD_GOALS,
+    enable_heavy: bool = False,
+    bucket_brokers: Optional[int] = None,
+    optimizer_cls=None,
+) -> SweepResult:
+    """Run the full goal optimizer on every scenario (sequential per scenario,
+    compiled programs shared through the common bucket shape).
+
+    Per-scenario verdicts carry POST-optimization violations, the real
+    movement bill, and the optimizer's provision verdict — the answer to
+    "what would the rebalanced hypothetical cluster look like", where
+    :func:`fast_sweep` answers "what does it look like as-is"."""
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.core.sensors import (
+        REGISTRY,
+        SIM_SCENARIOS_COUNTER,
+        SIM_SWEEPS_COUNTER,
+        SIM_SWEEP_TIMER,
+    )
+    from cruise_control_tpu.obs import recorder as obs
+    from cruise_control_tpu.sim.scenario import apply_scenario, broker_bucket
+
+    token = obs.start_trace("simulate")
+    t0 = time.monotonic()
+    goal_ids = tuple(goal_ids)
+    hard_ids = tuple(hard_ids)
+    scenarios = tuple(scenarios)
+    if not scenarios:
+        raise ValueError("deep_sweep needs at least one scenario")
+    B_need = max(base.num_brokers + s.add_brokers for s in scenarios)
+    B_pad = broker_bucket(B_need) if bucket_brokers is None else int(bucket_brokers)
+    ctx = GoalContext.build(base.num_topics, B_pad, constraint=constraint)
+    cls = optimizer_cls or GoalOptimizer
+    dispatches = 0
+    verdicts: List[ScenarioVerdict] = []
+    spans: List = []
+    for i, sc in enumerate(scenarios):
+        g0 = time.monotonic()
+        state = apply_scenario(base, sc, bucket_brokers=B_pad)
+        opt = cls(
+            goal_ids=sc.goal_order or goal_ids,
+            hard_ids=hard_ids,
+            enable_heavy_goals=enable_heavy,
+        )
+        _, result = opt.optimize(state, ctx)
+        dispatches += result.num_dispatches
+        name = sc.name or f"scenario-{i}"
+        verdicts.append(
+            ScenarioVerdict(
+                name=name,
+                violations=dict(result.violations_after),
+                hard_violations=result.residual_hard_violations,
+                violated_hard_goals=list(result.violated_hard_goals),
+                balancedness=result.balancedness_score,
+                satisfiable=not result.violated_hard_goals,
+                min_brokers_needed=(
+                    int(np.asarray(state.broker_alive).sum())
+                    + result.provision.num_brokers_to_add
+                    - result.provision.num_brokers_to_remove
+                ),
+                offline_moves=result.movement.num_inter_broker_moves,
+                offline_data_to_move=result.movement.inter_broker_data_to_move,
+                movement=dataclasses.asdict(result.movement),
+                provision_status=result.provision.status,
+            )
+        )
+        spans.append(
+            obs.Span(name, "scenario", time.monotonic() - g0, result.num_dispatches)
+        )
+
+    result = SweepResult(
+        scenarios=verdicts,
+        sweep_size=len(scenarios),
+        bucket=(B_pad, base.num_replicas, base.num_partitions),
+        num_dispatches=dispatches,
+        bucket_hit=False,
+        duration_s=time.monotonic() - t0,
+        deep=True,
+    )
+    REGISTRY.counter(SIM_SWEEPS_COUNTER).inc()
+    REGISTRY.counter(SIM_SCENARIOS_COUNTER).inc(len(scenarios))
+    REGISTRY.timer(SIM_SWEEP_TIMER).update(result.duration_s)
+    obs.finish_trace(token, spans=spans, attrs=_trace_attrs(result, goal_ids, None))
+    return result
+
+
+def _trace_attrs(result: SweepResult, goal_ids, mesh) -> dict:
+    from cruise_control_tpu.core.sensors import (
+        REGISTRY,
+        SIM_BUCKET_HITS_COUNTER,
+        SIM_BUCKET_MISSES_COUNTER,
+    )
+    from cruise_control_tpu.obs import recorder as obs
+
+    return {
+        "sweep_size": result.sweep_size,
+        "bucket_brokers": result.bucket[0],
+        "num_replicas": result.bucket[1],
+        "num_partitions": result.bucket[2],
+        "num_dispatches": result.num_dispatches,
+        "bucket_hit": result.bucket_hit,
+        "bucket_hits_total": REGISTRY.counter(SIM_BUCKET_HITS_COUNTER).value,
+        "bucket_misses_total": REGISTRY.counter(SIM_BUCKET_MISSES_COUNTER).value,
+        "num_goals": len(tuple(goal_ids)),
+        "deep": result.deep,
+        "sharded": mesh is not None,
+        **obs.mesh_metadata(),
+    }
+
+
+def _shard_scenarios(states: ClusterArrays, ctx: GoalContext, mesh, size: int):
+    """Lay the batch out scenario-data-parallel over the mesh.
+
+    Pads the scenario axis to a mesh multiple by repeating scenario 0 (callers
+    trim the tail), shards every state leaf on its leading axis, and
+    replicates the context."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cruise_control_tpu.parallel.mesh import REPLICA_AXIS, replicate
+
+    n = mesh.devices.size
+    pad = (-size) % n
+
+    def pad_leaf(x):
+        if pad == 0:
+            return x
+        return jnp.concatenate([x, jnp.repeat(x[:1], pad, axis=0)], axis=0)
+
+    states = jax.tree_util.tree_map(pad_leaf, states)
+
+    def shard_leaf(x):
+        spec = P(REPLICA_AXIS, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    states = jax.tree_util.tree_map(shard_leaf, states)
+    return states, replicate(ctx, mesh), pad

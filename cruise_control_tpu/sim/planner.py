@@ -1,0 +1,276 @@
+"""Capacity planner: minimum brokers for hard-goal satisfiability under load × f.
+
+Answers the provisioning question the reference's ``BasicProvisioner`` only
+shrugs at: *how many brokers does this cluster actually need?*  The planner
+sweeps candidate broker counts — each candidate is a
+:class:`~cruise_control_tpu.sim.scenario.Scenario` that adds empty brokers or
+decommissions the highest-index alive ones, under a global load multiplier —
+and finds the smallest satisfiable count by **batched bisection**: every
+round evaluates up to ``chunk`` candidates in ONE
+:func:`~cruise_control_tpu.sim.batch.fast_sweep` dispatch and narrows the
+bracket around the satisfiability edge.  Satisfiability is monotone in broker
+count (adding an empty broker only adds capacity), so a typical plan costs
+one or two dispatches end to end.
+
+The result feeds :class:`ProvisionRecommendation.sweep` — the marker that
+turns ``BasicProvisioner``'s placeholder ``COMPLETED_WITH_ERROR`` into a
+``COMPLETED`` verdict with real numbers behind it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.optimizer import (
+    OVERPROVISIONED_MIN_BROKERS,
+    OVERPROVISIONED_MIN_EXTRA_RACKS,
+    ProvisionRecommendation,
+)
+from cruise_control_tpu.model.arrays import ClusterArrays
+from cruise_control_tpu.sim.batch import fast_sweep
+from cruise_control_tpu.sim.scenario import Scenario, broker_bucket
+
+
+@dataclasses.dataclass
+class Probe:
+    """One evaluated candidate broker count."""
+
+    brokers: int
+    satisfiable: bool
+    min_brokers_needed: int
+
+
+@dataclasses.dataclass
+class CapacityPlan:
+    """Outcome of one capacity bisection."""
+
+    #: smallest alive-broker count with every hard goal satisfiable; None when
+    #: even the largest probed count cannot satisfy them
+    min_brokers: Optional[int]
+    current_brokers: int
+    load_factor: float
+    probes: List[Probe]
+    num_dispatches: int
+    duration_s: float
+    recommendation: ProvisionRecommendation
+
+    def to_dict(self) -> dict:
+        return {
+            "minBrokers": self.min_brokers,
+            "currentBrokers": self.current_brokers,
+            "loadFactor": self.load_factor,
+            "numDispatches": self.num_dispatches,
+            "durationS": round(self.duration_s, 4),
+            "probes": [dataclasses.asdict(p) for p in self.probes],
+            "recommendation": {
+                "status": self.recommendation.status,
+                "message": self.recommendation.message,
+                "numBrokersToAdd": self.recommendation.num_brokers_to_add,
+                "numBrokersToRemove": self.recommendation.num_brokers_to_remove,
+            },
+        }
+
+
+def _count_scenario(
+    alive_desc: List[int], base_brokers_alive: int, count: int, load_factor: float
+) -> Scenario:
+    """Scenario realizing ``count`` alive brokers under ``load × load_factor``.
+
+    Counts above the current cluster add empty brokers; counts below
+    decommission the highest-index alive brokers (the arbitrary-but-
+    deterministic choice — the satisfiability kernel prices totals, not
+    identities, so which brokers leave barely matters)."""
+    if count >= base_brokers_alive:
+        return Scenario(
+            name=f"brokers={count}",
+            add_brokers=count - base_brokers_alive,
+            load_factor=load_factor,
+        )
+    return Scenario(
+        name=f"brokers={count}",
+        remove_brokers=tuple(alive_desc[: base_brokers_alive - count]),
+        load_factor=load_factor,
+    )
+
+
+def plan_capacity(
+    base: ClusterArrays,
+    constraint: Optional[BalancingConstraint] = None,
+    load_factor: float = 1.0,
+    goal_ids: Sequence[int] = G.DEFAULT_GOAL_ORDER,
+    hard_ids: Sequence[int] = G.HARD_GOALS,
+    max_extra_brokers: Optional[int] = None,
+    chunk: int = 64,
+) -> CapacityPlan:
+    """Bisect broker count over the batched evaluator.
+
+    ``chunk`` bounds the scenarios per dispatch; ``max_extra_brokers`` caps the
+    search above the current count (default: double the cluster, floor 8)."""
+    from cruise_control_tpu.obs import recorder as obs
+
+    token = obs.start_trace("capacity_plan")
+    t0 = time.monotonic()
+    alive = np.asarray(base.broker_alive)
+    B0 = int(alive.sum())
+    alive_desc = [int(b) for b in np.flatnonzero(alive)[::-1]]
+
+    valid = np.asarray(base.replica_valid)
+    rf_max = 1
+    if valid.any():
+        counts = np.bincount(
+            np.asarray(base.replica_partition)[valid], minlength=base.num_partitions
+        )
+        rf_max = max(int(counts.max()), 1)
+
+    lo = max(rf_max, 1)                       # below RF nothing is satisfiable
+    extra = max_extra_brokers if max_extra_brokers is not None else max(B0, 8)
+    hi = max(B0 + extra, lo)
+    # the bucket must fit the TOTAL broker axis of the largest probe: base
+    # slots (dead brokers keep theirs) plus the added brokers of the hi probe
+    bucket = broker_bucket(base.num_brokers + max(hi - B0, 0))
+
+    probes: List[Probe] = []
+    dispatches = 0
+    spans: List = []
+
+    def evaluate(counts: List[int]) -> List[Probe]:
+        nonlocal dispatches
+        scs = [_count_scenario(alive_desc, B0, c, load_factor) for c in counts]
+        r0 = time.monotonic()
+        sweep = fast_sweep(
+            base, scs,
+            constraint=constraint, goal_ids=goal_ids, hard_ids=hard_ids,
+            bucket_brokers=bucket,
+        )
+        dispatches += sweep.num_dispatches
+        spans.append(
+            obs.Span(
+                f"round-{len(spans)}", "sweep", time.monotonic() - r0,
+                sweep.num_dispatches, attrs={"counts": counts},
+            )
+        )
+        out = [
+            Probe(c, v.satisfiable, v.min_brokers_needed)
+            for c, v in zip(counts, sweep.scenarios)
+        ]
+        probes.extend(out)
+        return out
+
+    # batched bisection: each round evaluates ≤ chunk counts spanning the
+    # bracket in ONE dispatch, then narrows to the satisfiability edge
+    lo_unsat, hi_sat = lo - 1, None
+    span_lo, span_hi = lo, hi
+    while span_hi - span_lo + 1 > 0:
+        n = span_hi - span_lo + 1
+        if n <= chunk:
+            counts = list(range(span_lo, span_hi + 1))
+        else:
+            counts = sorted(
+                {int(round(x)) for x in np.linspace(span_lo, span_hi, chunk)}
+            )
+        round_probes = evaluate(counts)
+        sat_counts = [p.brokers for p in round_probes if p.satisfiable]
+        unsat_counts = [p.brokers for p in round_probes if not p.satisfiable]
+        if sat_counts:
+            hi_sat = min(sat_counts) if hi_sat is None else min(hi_sat, min(sat_counts))
+        if unsat_counts:
+            below = [c for c in unsat_counts if hi_sat is None or c < hi_sat]
+            if below:
+                lo_unsat = max(lo_unsat, max(below))
+        if hi_sat is None:
+            break                              # nothing satisfiable up to hi
+        if hi_sat - lo_unsat <= 1:
+            break                              # edge pinned exactly
+        span_lo, span_hi = lo_unsat + 1, hi_sat - 1
+
+    min_brokers = hi_sat
+    racks_in_use = len(set(np.asarray(base.broker_rack)[alive].tolist()))
+    sweep_meta = {
+        "scenarios_evaluated": len(probes),
+        "num_dispatches": dispatches,
+        "load_factor": load_factor,
+        "min_brokers": min_brokers,
+        "current_brokers": B0,
+        "bucket_brokers": bucket,
+    }
+
+    if min_brokers is None:
+        needed = max((p.min_brokers_needed for p in probes), default=hi + 1)
+        rec = ProvisionRecommendation(
+            status="UNDER_PROVISIONED",
+            violated_hard_goals=[],
+            message=(
+                f"hard goals unsatisfiable even at {hi} brokers under load × "
+                f"{load_factor:g}; most constrained resource implies ≥ {needed} "
+                f"brokers ({len(probes)} scenarios, {dispatches} dispatches)"
+            ),
+            num_brokers_to_add=max(needed - B0, hi + 1 - B0),
+            sweep=sweep_meta,
+        )
+    elif min_brokers > B0:
+        rec = ProvisionRecommendation(
+            status="UNDER_PROVISIONED",
+            violated_hard_goals=[],
+            message=(
+                f"add {min_brokers - B0} broker(s): minimum satisfiable count "
+                f"under load × {load_factor:g} is {min_brokers} (current {B0}; "
+                f"{len(probes)} scenarios, {dispatches} dispatches)"
+            ),
+            num_brokers_to_add=min_brokers - B0,
+            sweep=sweep_meta,
+        )
+    else:
+        floor = max(min_brokers, OVERPROVISIONED_MIN_BROKERS)
+        surplus = B0 - floor
+        if surplus > 0 and racks_in_use >= rf_max + OVERPROVISIONED_MIN_EXTRA_RACKS:
+            rec = ProvisionRecommendation(
+                status="OVER_PROVISIONED",
+                violated_hard_goals=[],
+                message=(
+                    f"remove up to {surplus} broker(s): load × {load_factor:g} "
+                    f"fits on {floor} of {B0} brokers "
+                    f"({len(probes)} scenarios, {dispatches} dispatches)"
+                ),
+                num_brokers_to_remove=surplus,
+                sweep=sweep_meta,
+            )
+        else:
+            rec = ProvisionRecommendation(
+                status="RIGHT_SIZED",
+                violated_hard_goals=[],
+                message=(
+                    f"right-sized: minimum satisfiable count under load × "
+                    f"{load_factor:g} is {min_brokers} of {B0} brokers "
+                    f"({len(probes)} scenarios, {dispatches} dispatches)"
+                ),
+                sweep=sweep_meta,
+            )
+
+    plan = CapacityPlan(
+        min_brokers=min_brokers,
+        current_brokers=B0,
+        load_factor=load_factor,
+        probes=sorted(probes, key=lambda p: p.brokers),
+        num_dispatches=dispatches,
+        duration_s=time.monotonic() - t0,
+        recommendation=rec,
+    )
+    obs.finish_trace(
+        token,
+        spans=spans,
+        attrs={
+            "load_factor": load_factor,
+            "current_brokers": B0,
+            "min_brokers": min_brokers,
+            "num_dispatches": dispatches,
+            "scenarios_evaluated": len(probes),
+            "status": rec.status,
+        },
+    )
+    return plan

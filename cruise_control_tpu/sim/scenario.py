@@ -1,0 +1,327 @@
+"""Declarative what-if scenarios over a base cluster, batched and bucketed.
+
+A :class:`Scenario` names an edit of the base :class:`ClusterArrays`: add
+empty brokers, decommission (remove) or fail (kill) existing ones, drop a
+whole rack, scale the load globally or per topic, scale capacities per
+resource, or (deep path only) permute the goal priority list.  Applying a
+scenario is pure host-side numpy — the mutated cluster is data, not code, so
+hundreds of hypotheticals can share one compiled evaluator.
+
+Two invariants make the batch a single compiled dispatch:
+
+* **Common padded shapes.** Every scenario of a batch shares the base
+  replica/partition axes and a *bucketed* broker axis
+  (:func:`broker_bucket`: next power of two ≥ brokers-after-add) — padding
+  brokers carry ``broker_alive=False`` and zero capacity, so every evaluator
+  kernel (violations, snapshot averages, segment sums) ignores them by the
+  same masks it already uses for dead brokers.  Buckets form a small set of
+  shapes, so repeated sweeps with different broker counts reuse executables
+  instead of recompiling per scenario (the Execution-Templates caching
+  argument applied to capacity sweeps).
+* **Stacked pytree.** ``build_batch`` stacks the S mutated states leaf-wise
+  into one ``ClusterArrays`` whose every array has a leading scenario axis;
+  ``jax.vmap`` over it turns the per-cluster evaluator into a batched one with
+  no reshaping in the kernels (the batch-resource-allocation layout CvxCluster
+  uses to amortize 100-1000 solves into one).
+
+Semantics of the broker verbs (mirroring the reference's endpoints):
+
+* ``add_brokers`` — N new empty brokers (ADD_BROKER): alive, flagged NEW,
+  capacity = alive-mean base capacity × ``capacity_factors``, racks assigned
+  round-robin over existing racks;
+* ``remove_brokers`` — planned decommission (REMOVE_BROKER dryrun): the broker
+  is marked dead so its replicas count as offline/must-move, but leadership
+  bookkeeping is untouched (the drain has not happened yet);
+* ``kill_brokers`` / ``drop_rack`` — immediate failure: dead brokers AND
+  leadership already failed over to the lowest-index surviving replica
+  (leaderless, -1, when no replica survives) — the state the cluster is
+  actually in right after the outage, before any healing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.core.resources import NUM_RESOURCES
+from cruise_control_tpu.model.arrays import ClusterArrays
+
+#: floor of the broker-shape bucket ladder (tiny test clusters share one shape)
+MIN_BROKER_BUCKET = 8
+
+
+def broker_bucket(num_brokers: int) -> int:
+    """Bucketed broker-axis size: next power of two ≥ ``num_brokers``.
+
+    The ladder (8, 16, 32, …) keeps the set of compiled sweep shapes small:
+    every scenario over a 100-broker base with up to 28 added brokers lands in
+    the same 128-wide executable."""
+    n = max(int(num_brokers), MIN_BROKER_BUCKET)
+    return 1 << (n - 1).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One hypothetical edit of the base cluster (all fields optional)."""
+
+    name: str = ""
+    #: new empty brokers to add (ADD_BROKER semantics)
+    add_brokers: int = 0
+    #: broker ids to decommission (REMOVE_BROKER: dead, leadership untouched)
+    remove_brokers: Tuple[int, ...] = ()
+    #: broker ids that failed (dead + leadership already failed over)
+    kill_brokers: Tuple[int, ...] = ()
+    #: rack id whose brokers all failed (kill semantics)
+    drop_rack: Optional[int] = None
+    #: global load multiplier (all replicas and leadership deltas)
+    load_factor: float = 1.0
+    #: per-topic-id load multiplier, on top of ``load_factor``
+    topic_load_factors: Tuple[Tuple[int, float], ...] = ()
+    #: per-resource capacity multiplier [CPU, NW_IN, NW_OUT, DISK]
+    capacity_factors: Tuple[float, float, float, float] = (1.0, 1.0, 1.0, 1.0)
+    #: deep path only: run the full optimizer with this goal priority order
+    goal_order: Optional[Tuple[int, ...]] = None
+
+    def validate(self, base: ClusterArrays) -> None:
+        B = base.num_brokers
+        if self.add_brokers < 0:
+            raise ValueError(f"{self.name or 'scenario'}: add_brokers < 0")
+        if self.load_factor <= 0:
+            raise ValueError(f"{self.name or 'scenario'}: load_factor must be > 0")
+        if any(f <= 0 for f in self.capacity_factors):
+            raise ValueError(f"{self.name or 'scenario'}: capacity_factors must be > 0")
+        for b in tuple(self.remove_brokers) + tuple(self.kill_brokers):
+            if not (0 <= int(b) < B):
+                raise ValueError(f"{self.name or 'scenario'}: broker {b} out of range")
+        if self.drop_rack is not None and not (0 <= int(self.drop_rack) < base.num_racks):
+            raise ValueError(f"{self.name or 'scenario'}: rack {self.drop_rack} out of range")
+        for t, f in self.topic_load_factors:
+            if not (0 <= int(t) < base.num_topics):
+                raise ValueError(f"{self.name or 'scenario'}: topic {t} out of range")
+            if f <= 0:
+                raise ValueError(f"{self.name or 'scenario'}: topic load factor must be > 0")
+
+    # -- wire format (REST SIMULATE body) ------------------------------------
+
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name,
+            "add_brokers": self.add_brokers,
+            "remove_brokers": list(self.remove_brokers),
+            "kill_brokers": list(self.kill_brokers),
+            "drop_rack": self.drop_rack,
+            "load_factor": self.load_factor,
+            "topic_load_factors": {str(t): f for t, f in self.topic_load_factors},
+            "capacity_factors": list(self.capacity_factors),
+        }
+        if self.goal_order is not None:
+            d["goal_order"] = [G.GOAL_NAMES[g] for g in self.goal_order]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Scenario":
+        goal_order = None
+        if d.get("goal_order"):
+            ids = []
+            for g in d["goal_order"]:
+                if isinstance(g, str):
+                    if g not in G.GOAL_ID_BY_NAME:
+                        raise ValueError(f"unknown goal {g!r}")
+                    ids.append(G.GOAL_ID_BY_NAME[g])
+                else:
+                    ids.append(int(g))
+            goal_order = tuple(ids)
+        tlf = d.get("topic_load_factors") or {}
+        if isinstance(tlf, Mapping):
+            tlf = tuple((int(t), float(f)) for t, f in sorted(tlf.items(), key=lambda kv: int(kv[0])))
+        else:
+            tlf = tuple((int(t), float(f)) for t, f in tlf)
+        cf = d.get("capacity_factors") or (1.0, 1.0, 1.0, 1.0)
+        return cls(
+            name=str(d.get("name", "")),
+            add_brokers=int(d.get("add_brokers", 0)),
+            remove_brokers=tuple(int(b) for b in d.get("remove_brokers", ())),
+            kill_brokers=tuple(int(b) for b in d.get("kill_brokers", ())),
+            drop_rack=None if d.get("drop_rack") is None else int(d["drop_rack"]),
+            load_factor=float(d.get("load_factor", 1.0)),
+            topic_load_factors=tlf,
+            capacity_factors=tuple(float(f) for f in cf),
+            goal_order=goal_order,
+        )
+
+
+@dataclasses.dataclass
+class ScenarioBatch:
+    """S mutated clusters stacked leaf-wise into one batched ``ClusterArrays``.
+
+    Every leaf of ``states`` carries a leading scenario axis of size
+    ``len(scenarios)``; static metadata (rack/topic/host counts) is shared, so
+    the batch is a valid vmap operand."""
+
+    states: ClusterArrays          # leaves are [S, ...]
+    scenarios: Tuple[Scenario, ...]
+    #: (bucketed broker axis, replicas, partitions) — the compile shape key
+    bucket: Tuple[int, int, int]
+    base_brokers: int
+
+    @property
+    def size(self) -> int:
+        return len(self.scenarios)
+
+    @property
+    def names(self) -> List[str]:
+        return [s.name or f"scenario-{i}" for i, s in enumerate(self.scenarios)]
+
+
+def apply_scenario(
+    base: ClusterArrays, sc: Scenario, bucket_brokers: Optional[int] = None
+) -> ClusterArrays:
+    """Materialize one scenario as a broker-axis-padded ``ClusterArrays``.
+
+    ``bucket_brokers`` (default :func:`broker_bucket` of brokers-after-add)
+    fixes the padded broker dimension so differently-sized scenarios share one
+    compiled evaluator.  Pure numpy; returns a host-backed pytree (jax moves
+    it to device at dispatch)."""
+    import jax.numpy as jnp
+
+    sc.validate(base)
+    B = base.num_brokers
+    B_new = B + sc.add_brokers
+    B_pad = broker_bucket(B_new) if bucket_brokers is None else int(bucket_brokers)
+    if B_pad < B_new:
+        raise ValueError(
+            f"bucket_brokers={B_pad} smaller than brokers-after-add={B_new}"
+        )
+
+    rack = np.asarray(base.broker_rack)
+    host = np.asarray(base.broker_host)
+    cap = np.asarray(base.broker_capacity, dtype=np.float32)
+    alive = np.asarray(base.broker_alive).copy()
+    new = np.asarray(base.broker_new).copy()
+    demoted = np.asarray(base.broker_demoted).copy()
+
+    # broker-axis padding: slots [B, B_new) are the added brokers, [B_new,
+    # B_pad) inert padding.  Padding is indistinguishable from a dead broker
+    # with zero capacity and no replicas — exactly what every kernel masks.
+    pad = B_pad - B
+    rack_pad = np.concatenate([rack, (B + np.arange(pad, dtype=np.int32)) % max(base.num_racks, 1)])
+    host_pad = np.concatenate([host, base.num_hosts + np.arange(pad, dtype=np.int32)])
+    mean_cap = cap[alive].mean(axis=0) if alive.any() else cap.mean(axis=0)
+    cap_pad = np.concatenate([cap, np.zeros((pad, NUM_RESOURCES), np.float32)])
+    cap_pad[B:B_new] = mean_cap[None, :]
+    alive_pad = np.concatenate([alive, np.zeros(pad, bool)])
+    alive_pad[B:B_new] = True
+    new_pad = np.concatenate([new, np.zeros(pad, bool)])
+    new_pad[B:B_new] = True
+    demoted_pad = np.concatenate([demoted, np.zeros(pad, bool)])
+
+    dead = np.zeros(B_pad, bool)
+    for b in sc.remove_brokers:
+        dead[int(b)] = True
+    killed = np.zeros(B_pad, bool)
+    for b in sc.kill_brokers:
+        killed[int(b)] = True
+    if sc.drop_rack is not None:
+        killed[:B] |= rack == int(sc.drop_rack)
+    alive_pad &= ~(dead | killed)
+
+    cap_pad = cap_pad * np.asarray(sc.capacity_factors, np.float32)[None, :]
+
+    # load scaling: global factor × per-topic factor, applied to both the
+    # follower-equivalent base load and the leadership delta (the split is
+    # load-linear, so scaling preserves the base+is_leader·delta algebra)
+    topic_factor = np.ones(max(base.num_topics, 1), np.float32)
+    for t, f in sc.topic_load_factors:
+        topic_factor[int(t)] = f
+    ptopic = np.asarray(base.partition_topic)
+    pfac = (sc.load_factor * topic_factor[ptopic]).astype(np.float32)
+    rfac = pfac[np.asarray(base.replica_partition)]
+    base_load = np.asarray(base.base_load, np.float32) * rfac[:, None]
+    delta = np.asarray(base.leadership_delta, np.float32) * pfac[:, None]
+
+    # kill semantics: leadership has already failed over to the lowest-index
+    # surviving valid replica (Kafka's controller election on broker failure);
+    # partitions with no survivor become leaderless (-1)
+    leader = np.asarray(base.partition_leader).copy()
+    if killed.any():
+        rb = np.asarray(base.replica_broker)
+        valid = np.asarray(base.replica_valid)
+        leader_broker = np.where(leader >= 0, rb[np.maximum(leader, 0)], -1)
+        affected = (leader >= 0) & killed[np.maximum(leader_broker, 0)] & (leader_broker >= 0)
+        if affected.any():
+            R = base.num_replicas
+            P = base.num_partitions
+            # a survivor must sit on a broker that is alive AFTER the scenario
+            # — brokers already dead in the base cluster cannot take leadership
+            surv = valid & ~killed[rb] & np.asarray(base.broker_alive)[rb]
+            idx = np.arange(R, dtype=np.int64)
+            big = np.int64(R + 1)
+            order = np.where(surv, idx, big)
+            first = np.full(P, big, np.int64)
+            np.minimum.at(first, np.asarray(base.replica_partition), order)
+            new_leader = np.where(first < big, first, -1).astype(np.int32)
+            leader = np.where(affected, new_leader, leader).astype(np.int32)
+
+    disk_cap = np.asarray(base.disk_capacity, np.float32) * float(sc.capacity_factors[3])
+
+    return ClusterArrays(
+        replica_partition=jnp.asarray(np.asarray(base.replica_partition)),
+        replica_broker=jnp.asarray(np.asarray(base.replica_broker)),
+        replica_disk=jnp.asarray(np.asarray(base.replica_disk)),
+        replica_valid=jnp.asarray(np.asarray(base.replica_valid)),
+        base_load=jnp.asarray(base_load),
+        original_broker=jnp.asarray(np.asarray(base.original_broker)),
+        partition_topic=jnp.asarray(ptopic),
+        partition_leader=jnp.asarray(leader),
+        leadership_delta=jnp.asarray(delta),
+        broker_rack=jnp.asarray(rack_pad.astype(np.int32)),
+        broker_host=jnp.asarray(host_pad.astype(np.int32)),
+        broker_capacity=jnp.asarray(cap_pad),
+        broker_alive=jnp.asarray(alive_pad),
+        broker_new=jnp.asarray(new_pad),
+        broker_demoted=jnp.asarray(demoted_pad),
+        disk_broker=jnp.asarray(np.asarray(base.disk_broker)),
+        disk_capacity=jnp.asarray(disk_cap),
+        disk_alive=jnp.asarray(np.asarray(base.disk_alive)),
+        num_racks=base.num_racks,
+        num_topics=base.num_topics,
+        num_hosts=base.num_hosts + pad,
+    )
+
+
+def build_batch(
+    base: ClusterArrays,
+    scenarios: Sequence[Scenario],
+    bucket_brokers: Optional[int] = None,
+) -> ScenarioBatch:
+    """Stack S scenarios into one batched, padded, bucketed ``ClusterArrays``.
+
+    The bucket is the max brokers-after-add over the batch, rounded up the
+    bucket ladder (or an explicit ``bucket_brokers`` override — the bucket-
+    invariance contract says verdicts don't depend on it)."""
+    import jax.numpy as jnp
+
+    if not scenarios:
+        raise ValueError("build_batch needs at least one scenario")
+    scenarios = tuple(scenarios)
+    B_need = max(base.num_brokers + s.add_brokers for s in scenarios)
+    B_pad = broker_bucket(B_need) if bucket_brokers is None else int(bucket_brokers)
+    per = [apply_scenario(base, s, bucket_brokers=B_pad) for s in scenarios]
+
+    fields = {}
+    for f in dataclasses.fields(ClusterArrays):
+        v0 = getattr(per[0], f.name)
+        if f.metadata.get("pytree_node", True) is False or isinstance(v0, int):
+            fields[f.name] = v0
+            continue
+        fields[f.name] = jnp.stack([getattr(p, f.name) for p in per])
+    states = ClusterArrays(**fields)
+    return ScenarioBatch(
+        states=states,
+        scenarios=scenarios,
+        bucket=(B_pad, base.num_replicas, base.num_partitions),
+        base_brokers=base.num_brokers,
+    )

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Benchmark the sim/ capacity-sweep engine: scenarios/sec + dispatch count.
+
+Runs a fast-path sweep over the synthetic 100-broker/10k-partition cluster
+(the acceptance-criteria shape): one cold sweep (compiles the bucketed
+executable), then timed warm sweeps.  Reports wall clock, scenarios/sec and —
+the contract the sim/ design lives on — the compiled-dispatch count of a warm
+sweep (must stay ≤ 2) and that the warm sweep caused zero XLA compiles.
+
+    python scripts/bench_sim.py                  # 64 scenarios, JSON to stdout
+    python scripts/bench_sim.py --scenarios 256 --repeats 5 --out bench_sim.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+from cruise_control_tpu.obs import RECORDER  # noqa: E402
+from cruise_control_tpu.sim import Scenario, fast_sweep  # noqa: E402
+from cruise_control_tpu.synthetic import SyntheticSpec, generate  # noqa: E402
+
+
+def make_scenarios(n: int):
+    """Mixed capacity sweep: broker adds × load scaling × spot failures."""
+    out = []
+    for i in range(n):
+        out.append(
+            Scenario(
+                name=f"s{i}",
+                add_brokers=i % 8,
+                kill_brokers=(i % 5,) if i % 3 == 0 else (),
+                load_factor=1.0 + 0.02 * i,
+            )
+        )
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scenarios", type=int, default=64)
+    ap.add_argument("--brokers", type=int, default=100)
+    ap.add_argument("--partitions", type=int, default=10_000)
+    ap.add_argument("--rf", type=int, default=3)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    ap.add_argument("--max-dispatches", type=int, default=2,
+                    help="fail (exit 1) when a warm sweep exceeds this")
+    args = ap.parse_args()
+
+    spec = SyntheticSpec(
+        num_racks=10, num_brokers=args.brokers, num_topics=20,
+        num_partitions=args.partitions, replication_factor=args.rf, seed=7,
+        mean_cpu=0.08, mean_disk=0.08, mean_nw_in=0.08, mean_nw_out=0.06,
+    )
+    t0 = time.monotonic()
+    state, _ = generate(spec)
+    gen_s = time.monotonic() - t0
+    scs = make_scenarios(args.scenarios)
+
+    t0 = time.monotonic()
+    fast_sweep(state, scs)
+    cold_s = time.monotonic() - t0
+
+    walls = []
+    dispatches = compiles = 0
+    for _ in range(args.repeats):
+        t0 = time.monotonic()
+        r = fast_sweep(state, scs)
+        walls.append(time.monotonic() - t0)
+        dispatches = r.num_dispatches
+        trace = RECORDER.recent(limit=1, kind="simulate")[0]
+        compiles = len(trace.compile_events)
+
+    warm_s = min(walls)
+    report = {
+        "platform": jax.default_backend(),
+        "devices": jax.device_count(),
+        "cluster": {
+            "brokers": args.brokers,
+            "partitions": args.partitions,
+            "replicas": state.num_replicas,
+            "rf": args.rf,
+        },
+        "sweep_size": args.scenarios,
+        "bucket_brokers": r.bucket[0],
+        "generate_s": round(gen_s, 4),
+        "cold_sweep_s": round(cold_s, 4),
+        "warm_sweep_s": round(warm_s, 4),
+        "scenarios_per_s": round(args.scenarios / warm_s, 2),
+        "warm_dispatches": dispatches,
+        "warm_compile_events": compiles,
+    }
+    payload = json.dumps(report, indent=2)
+    print(payload)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload + "\n")
+
+    if dispatches > args.max_dispatches:
+        print(
+            f"FAIL: warm sweep used {dispatches} dispatches "
+            f"(budget {args.max_dispatches})",
+            file=sys.stderr,
+        )
+        return 1
+    if compiles:
+        print(
+            f"FAIL: warm sweep caused {compiles} XLA compile events",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

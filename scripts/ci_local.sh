@@ -18,6 +18,9 @@ python -m pytest tests/ -x -q -m "not slow"
 echo "== chaos tier (seeded fault injection; deterministic, also part of fast tier) =="
 python -m pytest tests/ -x -q -m chaos
 
+echo "== sim sweep smoke (64-scenario capacity sweep: ≤2 dispatches, 0 warm compiles) =="
+python scripts/bench_sim.py --repeats 1 >/dev/null
+
 echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check) =="
 python scripts/bench_gate.py
 
