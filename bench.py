@@ -70,8 +70,17 @@ def run_once(state, ctx):
 
 def main() -> None:
     platform = ensure_live_backend()
+
+    # opt-in persistent compilation cache (CC_TPU_COMPILE_CACHE): a cached
+    # run's "cold" phase measures deserialization instead of compilation
+    from cruise_control_tpu.core.compile_cache import configure_compile_cache
+
+    compile_cache = configure_compile_cache()
+
     state, ctx, maps = build()
-    run_once(state, ctx)              # compile warm-up
+    t0 = time.monotonic()
+    run_once(state, ctx)              # cold: includes the full program compile
+    cold_wall = time.monotonic() - t0
     t0 = time.monotonic()
     result = run_once(state, ctx)
     wall = time.monotonic() - t0
@@ -83,7 +92,13 @@ def main() -> None:
         json.dumps(
             {
                 "metric": "rebalance_proposal_wall_s_100brokers_10kpartitions",
+                # "value" is the WARM (steady-state) wall; the cold phase —
+                # first call, compile included — is reported separately so the
+                # artifact stops conflating compile time with solve time
                 "value": round(wall, 3),
+                "warm_wall_s": round(wall, 3),
+                "cold_wall_s": round(cold_wall, 3),
+                "compile_cache_dir": compile_cache,
                 "unit": "s",
                 "vs_baseline": round(NORTH_STAR_BUDGET_S / max(wall, 1e-9), 2),
                 "residual_hard_violations": residual_hard,

@@ -7,6 +7,7 @@ Counterpart of ``cruise-control/src/main/java/.../analyzer/`` — see
 from cruise_control_tpu.analyzer.constraint import BalancingConstraint
 from cruise_control_tpu.analyzer.context import GoalContext
 from cruise_control_tpu.analyzer.optimizer import (
+    BatchedResult,
     GoalOptimizer,
     GoalReport,
     MovementStats,
@@ -17,6 +18,7 @@ from cruise_control_tpu.analyzer.proposals import ExecutionProposal, diff
 
 __all__ = [
     "BalancingConstraint",
+    "BatchedResult",
     "GoalContext",
     "GoalOptimizer",
     "GoalReport",

@@ -77,40 +77,85 @@ class GoalContext:
         broker_set_of_broker: Sequence[int] = (),
         broker_set_of_topic: Sequence[int] = (),
     ) -> "GoalContext":
-        et = jnp.zeros(num_topics, bool)
+        # masks are BUILT with numpy (eager jnp ops would COMPILE tiny
+        # per-shape executables for every new broker count — exactly the
+        # recompile the bucketed main path exists to avoid), then the finished
+        # pytree is committed to device in ONE transfer: device_put is not a
+        # compile, and a device-resident context keeps the ~20 jit calls of
+        # every optimize from re-uploading the same six arrays per dispatch
+        import numpy as np
+
+        et = np.zeros(num_topics, bool)
         if excluded_topic_ids:
-            et = et.at[jnp.asarray(list(excluded_topic_ids), jnp.int32)].set(True)
-        el = jnp.zeros(num_brokers, bool)
+            et[list(excluded_topic_ids)] = True
+        el = np.zeros(num_brokers, bool)
         if excluded_brokers_for_leadership:
-            el = el.at[jnp.asarray(list(excluded_brokers_for_leadership), jnp.int32)].set(True)
-        er = jnp.zeros(num_brokers, bool)
+            el[list(excluded_brokers_for_leadership)] = True
+        er = np.zeros(num_brokers, bool)
         if excluded_brokers_for_replica_move:
-            er = er.at[jnp.asarray(list(excluded_brokers_for_replica_move), jnp.int32)].set(True)
-        ml = jnp.zeros(num_topics, bool)
+            er[list(excluded_brokers_for_replica_move)] = True
+        ml = np.zeros(num_topics, bool)
         if min_leader_topic_ids:
-            ml = ml.at[jnp.asarray(list(min_leader_topic_ids), jnp.int32)].set(True)
-        return cls(
+            ml[list(min_leader_topic_ids)] = True
+        ctx = cls(
             constraint=constraint if constraint is not None else BalancingConstraint.default(),
             excluded_topics=et,
             excluded_for_leadership=el,
             excluded_for_replica_move=er,
-            only_move_immigrants=jnp.asarray(only_move_immigrants),
-            triggered_by_violation=jnp.asarray(triggered_by_violation),
+            only_move_immigrants=np.asarray(only_move_immigrants),
+            triggered_by_violation=np.asarray(triggered_by_violation),
             min_leader_topics=ml,
-            fast_mode=jnp.asarray(fast_mode),
+            fast_mode=np.asarray(fast_mode),
             top_k=top_k,
             max_active_brokers=max_active_brokers,
             broker_set_of_broker=(
-                jnp.asarray(list(broker_set_of_broker), jnp.int32)
+                np.asarray(list(broker_set_of_broker), np.int32)
                 if broker_set_of_broker
-                else jnp.full(num_brokers, -1, jnp.int32)
+                else np.full(num_brokers, -1, np.int32)
             ),
             broker_set_of_topic=(
-                jnp.asarray(list(broker_set_of_topic), jnp.int32)
+                np.asarray(list(broker_set_of_topic), np.int32)
                 if broker_set_of_topic
-                else jnp.full(num_topics, -1, jnp.int32)
+                else np.full(num_topics, -1, np.int32)
             ),
         )
+        return jax.device_put(ctx)
+
+
+def pad_context_brokers(ctx: GoalContext, num_brokers: int) -> GoalContext:
+    """Pad the context's broker-axis masks to a bucketed broker dimension.
+
+    The bucketed main optimize path (``model.arrays.pad_brokers``) grows the
+    state's broker axis with inert dead slots; the context's per-broker masks
+    must grow in lockstep.  Padding slots are not excluded (they are dead and
+    zero-capacity, so every kernel already ignores them) and carry no broker
+    set (-1).  Host-side numpy — no dispatches."""
+    import numpy as np
+
+    B = ctx.excluded_for_leadership.shape[0]
+    if num_brokers == B:
+        return ctx
+    if num_brokers < B:
+        raise ValueError(
+            f"pad_context_brokers: target {num_brokers} smaller than current {B}"
+        )
+    pad = num_brokers - B
+    false_pad = np.zeros(pad, bool)
+    # numpy concatenation (no eager jnp compiles), then one device_put of the
+    # padded masks so the per-goal dispatches consume device-resident arrays
+    return ctx.replace(
+        excluded_for_leadership=jax.device_put(
+            np.concatenate([np.asarray(ctx.excluded_for_leadership), false_pad])
+        ),
+        excluded_for_replica_move=jax.device_put(
+            np.concatenate([np.asarray(ctx.excluded_for_replica_move), false_pad])
+        ),
+        broker_set_of_broker=jax.device_put(
+            np.concatenate(
+                [np.asarray(ctx.broker_set_of_broker), np.full(pad, -1, np.int32)]
+            )
+        ),
+    )
 
 
 @struct.dataclass

@@ -142,12 +142,30 @@ def provision_verdict(
     load would fit on materially fewer brokers (respecting replication factor,
     the max-replicas floor and the minimum broker/rack margins) — recommend
     removing the surplus.  Otherwise RIGHT_SIZED.
+
+    Pure numpy (the broker-load reduction included): this runs once per
+    optimize but B times per batched solve, where eager device chatter per
+    scenario would eat the batching win.
     """
     import numpy as np
 
     alive = np.asarray(state.broker_alive)
     n_alive = max(int(alive.sum()), 1)
-    bload = np.asarray(A.broker_load(state))
+    # numpy effective-load → per-broker reduction (A.broker_load without the
+    # eager jnp ops): base + is_leader·delta, summed per hosting broker
+    rp = np.asarray(state.replica_partition)
+    rb = np.asarray(state.replica_broker)
+    rvalid = np.asarray(state.replica_valid)
+    lead = (
+        np.asarray(state.partition_leader)[rp]
+        == np.arange(rp.shape[0], dtype=np.int64)
+    ) & rvalid
+    eff = np.asarray(state.base_load, np.float32) + np.where(
+        lead[:, None], np.asarray(state.leadership_delta, np.float32)[rp], 0.0
+    )
+    eff = np.where(rvalid[:, None], eff, 0.0)
+    bload = np.zeros((state.num_brokers, eff.shape[1]), np.float32)
+    np.add.at(bload, rb, eff)
     cap = np.asarray(state.broker_capacity)
     thr = np.asarray(ctx.constraint.resource_capacity_threshold)
     total_load = bload[alive].sum(axis=0)
@@ -155,15 +173,12 @@ def provision_verdict(
     needed_by_res = int(
         np.ceil((total_load / np.maximum(usable_per_broker, 1e-9)).max())
     )
-    valid = np.asarray(state.replica_valid)
     rf_max = 0
-    if valid.any():
-        counts = np.bincount(
-            np.asarray(state.replica_partition)[valid], minlength=state.num_partitions
-        )
+    if rvalid.any():
+        counts = np.bincount(rp[rvalid], minlength=state.num_partitions)
         rf_max = int(counts.max())
     needed_by_count = int(
-        np.ceil(valid.sum() / OVERPROVISIONED_MAX_REPLICAS_PER_BROKER)
+        np.ceil(rvalid.sum() / OVERPROVISIONED_MAX_REPLICAS_PER_BROKER)
     )
     needed = max(needed_by_res, needed_by_count, rf_max, OVERPROVISIONED_MIN_BROKERS)
 
@@ -306,6 +321,21 @@ class OptimizerResult:
         return score
 
 
+@dataclasses.dataclass
+class BatchedResult:
+    """Outcome of one :meth:`GoalOptimizer.batched_optimize` call.
+
+    ``results[i]`` is scenario *i*'s :class:`OptimizerResult`; the dispatch
+    budget is shared by the whole batch — ``num_dispatches`` is the total for
+    all B optimizations (#goals + 4), and each per-scenario result carries the
+    same number (the batch is the dispatch unit, not the scenario)."""
+
+    results: List[OptimizerResult]
+    batch_size: int
+    num_dispatches: int
+    duration_s: float
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -373,19 +403,53 @@ def _phase_loop(state, ctx, *, round_fn, max_rounds, enable_heavy, prior_ids, ad
 #: fused ones (the round-4 fused-only layout tripled cold-compile wall on a
 #: 1-core host and blew the multichip-dryrun window; see BENCH_r04/
 #: MULTICHIP_r04).
-_phase = partial(
-    jax.jit,
-    static_argnames=("round_fn", "max_rounds", "enable_heavy", "prior_ids", "admit_ids"),
+#:
+#: Each step exists in up to three jit flavors sharing one traced function:
+#:  - plain        — the FIRST consumer of a caller-owned state (the input
+#:    pytree must survive: gate/bench/tests re-optimize the same state);
+#:  - ``*_don``    — ``donate_argnums=(0,)`` on the state: every later step
+#:    consumes an intermediate owned by optimize(), so its buffers alias the
+#:    outputs instead of forcing XLA to allocate a second copy of the whole
+#:    cluster per step (the buffer-donation half of the compile-amortization
+#:    layer; a no-op where the backend lacks donation support);
+#:  - ``*_b``/``*_b_don`` — ``jax.vmap`` over a stacked scenario axis with a
+#:    shared context: the whole-batch programs behind ``batched_optimize``.
+_PHASE_STATICS = ("round_fn", "max_rounds", "enable_heavy", "prior_ids", "admit_ids")
+_phase = partial(jax.jit, static_argnames=_PHASE_STATICS)(_phase_loop)
+_phase_don = partial(
+    jax.jit, static_argnames=_PHASE_STATICS, donate_argnums=(0,)
 )(_phase_loop)
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "gid", "round_fns", "max_rounds", "enable_heavy", "prior_ids", "admit_ids",
-    ),
+def _vmap_step(fn):
+    """Lift a per-cluster step to a stacked [S, ...] state (context shared).
+
+    The step must be a pure jittable function of ``(state, ctx, **statics)``
+    whose control flow is shape-static (``lax.while_loop`` inside) — exactly
+    what makes it vmappable.  Under vmap the while loops run until EVERY lane
+    converges; a converged lane's extra rounds apply zero moves (a converged
+    state is a fixpoint of its own round), so per-lane placements are
+    unchanged — only the per-lane round counters absorb the global trip count.
+    """
+
+    def run(states, ctx, **statics):
+        return jax.vmap(lambda s: fn(s, ctx, **statics))(states)
+
+    return run
+
+
+_phase_b = partial(jax.jit, static_argnames=_PHASE_STATICS)(_vmap_step(_phase_loop))
+_phase_b_don = partial(
+    jax.jit, static_argnames=_PHASE_STATICS, donate_argnums=(0,)
+)(_vmap_step(_phase_loop))
+
+
+_GOAL_STEP_STATICS = (
+    "gid", "round_fns", "max_rounds", "enable_heavy", "prior_ids", "admit_ids",
 )
-def _goal_step(
+
+
+def _goal_step_fn(
     state, ctx, *, gid, round_fns, max_rounds, enable_heavy, prior_ids, admit_ids
 ):
     """One goal = ONE device dispatch (the default, ``fuse_goal_dispatch``):
@@ -445,8 +509,16 @@ def _goal_step(
     return state, rounds, moves, before, after
 
 
-@partial(jax.jit, static_argnames=("max_rf", "enable_heavy"))
-def _assigner_step(state, ctx, *, max_rf, enable_heavy):
+_goal_step = partial(jax.jit, static_argnames=_GOAL_STEP_STATICS)(_goal_step_fn)
+_goal_step_don = partial(
+    jax.jit, static_argnames=_GOAL_STEP_STATICS, donate_argnums=(0,)
+)(_goal_step_fn)
+_goal_step_b_don = partial(
+    jax.jit, static_argnames=_GOAL_STEP_STATICS, donate_argnums=(0,)
+)(_vmap_step(_goal_step_fn))
+
+
+def _assigner_step_fn(state, ctx, *, max_rf, enable_heavy):
     """KafkaAssignerEvenRackAwareGoal as one dispatch: the constructive
     even/rack-aware placement (analyzer.kafka_assigner) + the goal's own
     before/after violation scalars (rack validity + per-position evenness).
@@ -466,6 +538,16 @@ def _assigner_step(state, ctx, *, max_rf, enable_heavy):
     return state, jnp.int32(1), moves, before, after, unassigned
 
 
+_ASSIGNER_STATICS = ("max_rf", "enable_heavy")
+_assigner_step = partial(jax.jit, static_argnames=_ASSIGNER_STATICS)(_assigner_step_fn)
+_assigner_step_don = partial(
+    jax.jit, static_argnames=_ASSIGNER_STATICS, donate_argnums=(0,)
+)(_assigner_step_fn)
+_assigner_step_b_don = partial(
+    jax.jit, static_argnames=_ASSIGNER_STATICS, donate_argnums=(0,)
+)(_vmap_step(_assigner_step_fn))
+
+
 def _max_replication_factor(state: ClusterArrays) -> int:
     """Host-side maxRF (clusterModel.maxReplicationFactor) — static shape
     parameter for the assigner's position loop."""
@@ -480,10 +562,22 @@ def _max_replication_factor(state: ClusterArrays) -> int:
     return max(int(counts.max()), 1)
 
 
-@partial(jax.jit, static_argnames=("enable_heavy", "subset"))
-def _violations(state, ctx, enable_heavy=False, subset=None):
+def _violations_fn(state, ctx, enable_heavy=False, subset=None):
     snap = take_snapshot(state, ctx, enable_heavy)
     return G.violations_all(state, ctx, snap, subset=subset)
+
+
+_violations = partial(
+    jax.jit, static_argnames=("enable_heavy", "subset")
+)(_violations_fn)
+
+
+@partial(jax.jit, static_argnames=("enable_heavy", "subset"))
+def _violations_b(states, ctx, enable_heavy=False, subset=None):
+    """[S, NUM_GOALS] violation counts for a stacked scenario axis."""
+    return jax.vmap(
+        lambda s: _violations_fn(s, ctx, enable_heavy, subset)
+    )(states)
 
 
 # -- real per-goal durations without host sync --------------------------------------
@@ -560,6 +654,7 @@ class GoalOptimizer:
         max_rounds_per_phase: int = 2000,
         enable_heavy_goals: bool = True,
         fuse_goal_dispatch: bool | None = None,
+        bucket_brokers: bool | None = None,
     ) -> None:
         self.enable_heavy_goals = enable_heavy_goals
         self.goal_ids = tuple(
@@ -584,6 +679,44 @@ class GoalOptimizer:
         self._fuse_goal_dispatch = (
             None if fuse_goal_dispatch is None else bool(fuse_goal_dispatch)
         )
+        self._bucket_brokers = (
+            None if bucket_brokers is None else bool(bucket_brokers)
+        )
+
+    @property
+    def bucket_brokers(self) -> bool:
+        """Pad the broker axis of ``optimize()`` inputs to the power-of-two
+        bucket ladder (model.arrays.broker_bucket) so a growing cluster keeps
+        hitting the same compiled executables: every detector/API-triggered
+        rebalance between 65 and 128 brokers shares one program set, and a
+        restart with the persistent compilation cache starts warm.  Padding is
+        inert (dead zero-capacity brokers) — results are identical.
+        CC_TPU_BUCKET_BROKERS=0 restores exact-shape compilation."""
+        if self._bucket_brokers is None:
+            env = os.environ.get("CC_TPU_BUCKET_BROKERS")
+            self._bucket_brokers = (
+                env not in ("0", "false", "") if env is not None else True
+            )
+        return self._bucket_brokers
+
+    @bucket_brokers.setter
+    def bucket_brokers(self, value: bool) -> None:
+        self._bucket_brokers = bool(value)
+
+    def _bucketed(self, state: ClusterArrays, ctx: GoalContext):
+        """(padded state, padded ctx, restore fn) for the bucketed main path."""
+        from cruise_control_tpu.analyzer.context import pad_context_brokers
+
+        B = state.num_brokers
+        bucket = A.broker_bucket(B) if self.bucket_brokers else B
+        if bucket == B:
+            return state, ctx, lambda s: s
+        hosts = state.num_hosts
+        return (
+            A.pad_brokers(state, bucket),
+            pad_context_brokers(ctx, bucket),
+            lambda s: A.unpad_brokers(s, B, hosts),
+        )
 
     @property
     def fuse_goal_dispatch(self) -> bool:
@@ -605,6 +738,27 @@ class GoalOptimizer:
         self._fuse_goal_dispatch = bool(value)
 
     def optimize(
+        self,
+        state: ClusterArrays,
+        ctx: GoalContext,
+        maps=None,
+        raise_on_hard_failure: bool = False,
+        profile_goals: bool = False,
+        on_goal_done=None,
+    ) -> Tuple[ClusterArrays, OptimizerResult]:
+        """Bucketed entry: pad the broker axis to the compile-shape ladder
+        (``bucket_brokers``, default on), solve, and slice the final state
+        back — callers never see the padding.  See :meth:`_optimize_core` for
+        the solve itself."""
+        state, ctx, unbucket = self._bucketed(state, ctx)
+        final, result = self._optimize_core(
+            state, ctx, maps=maps,
+            raise_on_hard_failure=raise_on_hard_failure,
+            profile_goals=profile_goals, on_goal_done=on_goal_done,
+        )
+        return unbucket(final), result
+
+    def _optimize_core(
         self,
         state: ClusterArrays,
         ctx: GoalContext,
@@ -658,11 +812,15 @@ class GoalOptimizer:
         # repair lands feasibly when it can); the relaxed pass bounds nothing —
         # draining dead brokers beats transient overload (goals rebalance after).
         hard_in_list = tuple(g for g in self.hard_ids if g in self.goal_ids)
-        for fn, aids in (
-            (offline_round, hard_in_list),
-            (offline_round_relaxed, ()),
+        # the FIRST dispatch to return a new state uses the non-donating jit:
+        # the input pytree belongs to the caller (gate/bench re-optimize the
+        # same state); every later step consumes an intermediate we own and
+        # donates its buffers
+        for phase_jit, (fn, aids) in zip(
+            (_phase, _phase_don),
+            ((offline_round, hard_in_list), (offline_round_relaxed, ())),
         ):
-            state, _, _ = _phase(
+            state, _, _ = phase_jit(
                 state, ctx,
                 round_fn=fn, max_rounds=max_rounds, enable_heavy=heavy,
                 prior_ids=(), admit_ids=aids,
@@ -706,7 +864,7 @@ class GoalOptimizer:
                 d0 = dispatches
                 if gid == G.KAFKA_ASSIGNER_RACK:
                     # full placement mode, not an improvement loop (kafkaassigner/)
-                    state, rounds, moves, before, after, unassigned = _assigner_step(
+                    state, rounds, moves, before, after, unassigned = _assigner_step_don(
                         state, ctx,
                         max_rf=_max_replication_factor(initial),
                         enable_heavy=heavy,
@@ -718,7 +876,7 @@ class GoalOptimizer:
                         )
                         dispatches += 1
                 elif fused:
-                    state, rounds, moves, before, after = _goal_step(
+                    state, rounds, moves, before, after = _goal_step_don(
                         state, ctx,
                         gid=gid,
                         round_fns=GOAL_ROUNDS[gid],
@@ -735,7 +893,7 @@ class GoalOptimizer:
                     for _pass in range(n_passes):
                         pass_moves = jnp.int32(0)
                         for round_fn in GOAL_ROUNDS[gid]:
-                            state, r, m = _phase(
+                            state, r, m = _phase_don(
                                 state, ctx,
                                 round_fn=round_fn,
                                 max_rounds=max_rounds,
@@ -927,3 +1085,196 @@ class GoalOptimizer:
             },
         )
         return state, result
+
+    def batched_optimize(
+        self, states: ClusterArrays, ctx: GoalContext
+    ) -> Tuple[ClusterArrays, BatchedResult]:
+        """Run the FULL goal list over a stacked scenario axis in one pass:
+        B complete optimizations for ~(#goals + 4) dispatches total instead of
+        B × (#goals + 4).
+
+        ``states`` is a batched :class:`ClusterArrays` whose every array leaf
+        carries a leading scenario axis (``model.arrays.stack_arrays`` /
+        ``sim.scenario.build_batch`` — scenarios share one padded broker
+        bucket); ``ctx`` is shared by every lane.  Each goal step is the same
+        fused ``_goal_step`` program lifted by ``jax.vmap`` — the per-goal
+        ``lax.while_loop``s run until every lane converges, and a converged
+        lane's extra rounds are provably zero-move, so per-lane placements
+        equal the one-at-a-time path (asserted by tests/test_sim.py).  Every
+        per-lane scalar stays on device until ONE bulk fetch at the end.
+
+        Restrictions vs :meth:`optimize` (all irrelevant to sweep callers):
+        always the fused dispatch layout, no proposal diffing (``maps``), no
+        per-goal profiling or hard-failure raising, and per-scenario
+        ``stats_before/after`` are left empty — computing B stats pytrees
+        host-side would dominate the wall time the batching just saved.
+        """
+        import numpy as np
+
+        from cruise_control_tpu.core.sensors import (
+            PROPOSAL_COMPUTATION_TIMER,
+            REGISTRY,
+        )
+        from cruise_control_tpu.obs import recorder as obs
+
+        trace_token = obs.start_trace("optimize")
+        t0 = time.monotonic()
+        heavy = self.enable_heavy_goals
+        S = int(states.base_load.shape[0])
+        initial = states
+        dispatches = 0
+        viol0 = _violations_b(states, ctx, enable_heavy=heavy, subset=self.goal_ids)
+        dispatches += 1
+
+        max_rounds = self.max_rounds_per_phase
+        if bool(ctx.fast_mode):
+            max_rounds = min(max_rounds, FAST_MODE_MAX_ROUNDS)
+
+        hard_in_list = tuple(g for g in self.hard_ids if g in self.goal_ids)
+        # non-donating first: the stacked input belongs to the caller
+        for phase_jit, (fn, aids) in zip(
+            (_phase_b, _phase_b_don),
+            ((offline_round, hard_in_list), (offline_round_relaxed, ())),
+        ):
+            states, _, _ = phase_jit(
+                states, ctx,
+                round_fn=fn, max_rounds=max_rounds, enable_heavy=heavy,
+                prior_ids=(), admit_ids=aids,
+            )
+            dispatches += 1
+        setup_dispatches = dispatches
+        setup_s = time.monotonic() - t0
+
+        raw: List[tuple] = []
+        goal_walls: List[float] = []
+        prior: Tuple[int, ...] = ()
+        for gid in self.goal_ids:
+            g0 = time.monotonic()
+            if gid == G.KAFKA_ASSIGNER_RACK:
+                # static loop bound: the max RF over every lane (positions past
+                # a partition's actual RF are no-ops in the placement kernel)
+                valid = np.asarray(initial.replica_valid)
+                rp = np.asarray(initial.replica_partition)
+                P = int(initial.partition_topic.shape[-1])
+                max_rf = 1
+                for i in range(S):
+                    if valid[i].any():
+                        max_rf = max(
+                            max_rf,
+                            int(np.bincount(rp[i][valid[i]], minlength=P).max()),
+                        )
+                states, rounds, moves, before, after, _ = _assigner_step_b_don(
+                    states, ctx, max_rf=max_rf, enable_heavy=heavy
+                )
+            else:
+                states, rounds, moves, before, after = _goal_step_b_don(
+                    states, ctx,
+                    gid=gid,
+                    round_fns=GOAL_ROUNDS[gid],
+                    max_rounds=max_rounds,
+                    enable_heavy=heavy,
+                    prior_ids=prior, admit_ids=prior + (gid,),
+                )
+            dispatches += 1
+            raw.append((gid, before, after, rounds, moves))
+            goal_walls.append(time.monotonic() - g0)
+            prior = prior + (gid,)
+
+        violN = _violations_b(states, ctx, enable_heavy=heavy, subset=self.goal_ids)
+        dispatches += 1
+
+        # ONE bulk fetch: per-goal [S] scalars, the violation matrices, and
+        # the final states (device_get is a transfer, not a dispatch)
+        viol0_np, violN_np, fetched, final_np, init_np = jax.device_get(
+            (viol0, violN,
+             [(vb, va, r, m) for _, vb, va, r, m in raw],
+             states, initial)
+        )
+
+        names = G.GOAL_NAMES
+        duration = time.monotonic() - t0
+        results: List[OptimizerResult] = []
+        for i in range(S):
+            final_i = jax.tree_util.tree_map(lambda x: x[i], final_np)
+            init_i = jax.tree_util.tree_map(lambda x: x[i], init_np)
+            reports = [
+                GoalReport(
+                    goal_id=gid,
+                    name=names[gid],
+                    is_hard=gid in self.hard_ids,
+                    violations_before=float(vb[i]),
+                    violations_after=float(va[i]),
+                    rounds=int(r[i]),
+                    moves_applied=int(m[i]),
+                    duration_s=wall,
+                )
+                for (gid, *_), (vb, va, r, m), wall in zip(raw, fetched, goal_walls)
+            ]
+            violated_hard = [
+                names[g] for g in self.hard_ids
+                if g in self.goal_ids and float(violN_np[i, g]) > 0
+            ]
+            results.append(
+                OptimizerResult(
+                    goal_reports=reports,
+                    violations_before={
+                        names[g]: float(viol0_np[i, g]) for g in self.goal_ids
+                    },
+                    violations_after={
+                        names[g]: float(violN_np[i, g]) for g in self.goal_ids
+                    },
+                    stats_before={},
+                    stats_after={},
+                    proposals=[],
+                    provision=provision_verdict(final_i, ctx, violated_hard),
+                    total_moves=int(sum(int(m[i]) for _, _, _, m in fetched)),
+                    duration_s=duration,
+                    movement=movement_stats(init_i, final_i),
+                    num_dispatches=dispatches,
+                )
+            )
+
+        batched = BatchedResult(
+            results=results,
+            batch_size=S,
+            num_dispatches=dispatches,
+            duration_s=duration,
+        )
+        REGISTRY.timer(PROPOSAL_COMPUTATION_TIMER).update(duration)
+
+        spans = [obs.Span("setup", "setup", setup_s, setup_dispatches)]
+        for (gid, *_), (vb, va, r, m), wall in zip(raw, fetched, goal_walls):
+            spans.append(
+                obs.Span(
+                    names[gid], "goal", wall, 1,
+                    attrs={
+                        "moves": int(m.sum()),
+                        "lanes_unsatisfied": int((va > 0).sum()),
+                        "hard": gid in self.hard_ids,
+                    },
+                )
+            )
+        spans.append(
+            obs.Span(
+                "finalize", "finalize",
+                max(duration - setup_s - sum(goal_walls), 0.0),
+                dispatches - setup_dispatches - len(raw),
+            )
+        )
+        obs.finish_trace(
+            trace_token,
+            spans=spans,
+            attrs={
+                "batched": True,
+                "batch_size": S,
+                "num_goals": len(self.goal_ids),
+                "num_dispatches": dispatches,
+                # leaves are [S, ...]-stacked: the trailing axis is the shape
+                "num_brokers": int(states.broker_rack.shape[-1]),
+                "num_partitions": int(states.partition_topic.shape[-1]),
+                "num_replicas": int(states.replica_partition.shape[-1]),
+                "fast_mode": bool(ctx.fast_mode),
+                **obs.mesh_metadata(),
+            },
+        )
+        return final_np, batched

@@ -129,6 +129,16 @@ class CruiseControlTpuApp:
         cfg = Config(cruise_control_config(), props)
         self.config = cfg
 
+        # persistent compilation cache: a restarted server deserializes the
+        # solver's compiled programs instead of re-paying the cold compile
+        # (compile.cache.dir, falling back to $CC_TPU_COMPILE_CACHE; no-op
+        # when neither is set)
+        from cruise_control_tpu.core.compile_cache import configure_compile_cache
+
+        self.compile_cache_dir = configure_compile_cache(
+            cfg.get("compile.cache.dir") or None
+        )
+
         self._demo_backend = False
         if backend is None:
             spec = props.get("cluster.backend.class")

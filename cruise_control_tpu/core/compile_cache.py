@@ -1,0 +1,56 @@
+"""Persistent XLA compilation cache wiring (the restart half of compile
+amortization).
+
+The bucketed solver shapes (``model.arrays.broker_bucket``) make a *running*
+process reuse executables across growing clusters; this module makes a
+*restarted* process reuse them too: with ``CC_TPU_COMPILE_CACHE`` (or the
+``compile.cache.dir`` config key) pointing at a directory, JAX serializes
+every compiled program there and later processes deserialize instead of
+recompiling — the ~30-program cold compile that blew the round-4 multichip
+window (see the ``_phase`` comment in ``analyzer/optimizer.py``) becomes a
+one-time cost per (jax version, shape bucket, goal list).  CI persists the
+directory across runs with ``actions/cache`` so the gate and bench jobs start
+warm.
+
+The cache is strictly opt-in: nothing is configured unless a path is given.
+(Deserialized executables are machine-feature-sensitive — a cache written on
+a host with different CPU features can SIGILL on load, which is why the test
+suite never enables it; see tests/conftest.py.)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+#: environment variable naming the cache directory (config key
+#: ``compile.cache.dir`` overrides it when set)
+COMPILE_CACHE_ENV = "CC_TPU_COMPILE_CACHE"
+
+
+def configure_compile_cache(
+    path: Optional[str] = None,
+    _config_update: Optional[Callable[[str, object], None]] = None,
+) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path`` and enable it for
+    every program (no minimum size / compile-time gates — the solver's many
+    small phase programs are exactly what a restart should not re-lower).
+
+    ``path`` defaults to ``$CC_TPU_COMPILE_CACHE``; returns the directory in
+    use, or None when unconfigured (the no-op default).  ``_config_update``
+    injects the config setter for tests — enabling the real cache mid-suite
+    can crash this host's AOT loader (conftest.py).
+    """
+    path = path or os.environ.get(COMPILE_CACHE_ENV)
+    if not path:
+        return None
+    path = os.path.expanduser(path)
+    os.makedirs(path, exist_ok=True)
+    if _config_update is None:
+        import jax
+
+        _config_update = jax.config.update
+    _config_update("jax_compilation_cache_dir", path)
+    _config_update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _config_update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return path

@@ -128,6 +128,11 @@ def analyzer_config() -> ConfigDef:
              "Solver top-k: candidate actions nominated per broker per round "
              "(TPU-specific; the depth of the parallel SortedReplicas walk).",
              in_range(lo=1))
+    d.define("compile.cache.dir", Type.STRING, "", M,
+             "Directory for JAX's persistent compilation cache: restarts "
+             "deserialize the solver's compiled programs instead of paying "
+             "the ~30-program cold compile (TPU-specific; empty = env "
+             "CC_TPU_COMPILE_CACHE, unset = no persistent cache).")
     return d
 
 

@@ -326,9 +326,12 @@ class CruiseControl:
         load_factor: float = 1.0,
         goal_ids: Optional[Sequence[int]] = None,
         max_extra_brokers: Optional[int] = None,
+        deep_verify: bool = False,
     ) -> "CapacityPlan":
         """Batched-bisection capacity plan (the RIGHTSIZE substrate): minimum
-        brokers such that every hard goal is satisfiable under load × f."""
+        brokers such that every hard goal is satisfiable under load × f.
+        ``deep_verify`` confirms the pinned edge with one batched full-solver
+        pass (``sim.planner.plan_capacity``)."""
         from cruise_control_tpu.sim.planner import plan_capacity as _plan
 
         model = self.cluster_model()
@@ -341,6 +344,7 @@ class CruiseControl:
             goal_ids=gids,
             hard_ids=tuple(g for g in self.hard_ids if g in gids) or self.hard_ids,
             max_extra_brokers=max_extra_brokers,
+            deep_verify=deep_verify,
         )
 
     def train_cpu_model(self, from_ms: int = 0, to_ms: Optional[int] = None) -> bool:

@@ -32,7 +32,8 @@ T = topics, D = disks (JBOD logdirs; D may be 0).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -231,6 +232,133 @@ def replicas_per_rack_per_partition(state: ClusterArrays) -> jax.Array:
         num_segments=state.num_partitions * state.num_racks,
     )
     return counts.reshape(state.num_partitions, state.num_racks)
+
+
+# ---------------------------------------------------------------------------
+# Broker-axis bucketing (shared by the main optimize path and sim/ sweeps).
+# ---------------------------------------------------------------------------
+#
+# The broker axis is the only cluster dimension that changes between routine
+# rebalances (brokers join/leave; the replica/partition axes are fixed by the
+# model build).  Padding it to a small ladder of power-of-two buckets keeps
+# the set of compiled solver shapes tiny: every cluster between 65 and 128
+# brokers shares one executable, so a detector-triggered optimize on a grown
+# cluster — or a process restart hitting the persistent compilation cache —
+# pays zero recompiles.  Padding slots are indistinguishable from dead brokers
+# with zero capacity and no replicas, which every kernel already masks.
+
+#: floor of the broker-shape bucket ladder (tiny test clusters share one shape)
+MIN_BROKER_BUCKET = 8
+
+
+def broker_bucket(num_brokers: int) -> int:
+    """Bucketed broker-axis size: next power of two ≥ ``num_brokers``.
+
+    The ladder (8, 16, 32, …) keeps the set of compiled solver shapes small:
+    every cluster between 65 and 128 brokers lands in the same 128-wide
+    executable."""
+    n = max(int(num_brokers), MIN_BROKER_BUCKET)
+    return 1 << (n - 1).bit_length()
+
+
+def pad_brokers(state: ClusterArrays, num_brokers: int) -> ClusterArrays:
+    """Pad the broker axis to ``num_brokers`` with inert slots (host-side).
+
+    Padding brokers are dead (``broker_alive=False``), have zero capacity, a
+    fresh host id each, and a round-robin rack assignment — exactly a dead
+    broker hosting nothing, which every evaluator/solver kernel masks out.
+    Replica/partition/disk arrays are untouched (no replica references a
+    padding slot).  Pure numpy: returns a host-backed pytree, no dispatches.
+    """
+    import numpy as np
+
+    B = state.num_brokers
+    if num_brokers == B:
+        return state
+    if num_brokers < B:
+        raise ValueError(
+            f"pad_brokers: target {num_brokers} smaller than current {B}"
+        )
+    pad = num_brokers - B
+    rack = np.asarray(state.broker_rack)
+    rack_pad = np.concatenate(
+        [rack, (B + np.arange(pad, dtype=np.int32)) % max(state.num_racks, 1)]
+    ).astype(np.int32)
+    host_pad = np.concatenate(
+        [np.asarray(state.broker_host),
+         state.num_hosts + np.arange(pad, dtype=np.int32)]
+    ).astype(np.int32)
+    cap_pad = np.concatenate(
+        [np.asarray(state.broker_capacity, np.float32),
+         np.zeros((pad, NUM_RESOURCES), np.float32)]
+    )
+    false_pad = np.zeros(pad, bool)
+    # leaves stay numpy (jax converts at the dispatch boundary): this runs
+    # per-scenario at sweep scale, where eager per-leaf device_puts cost more
+    # than the batched dispatch they feed
+    return state.replace(
+        broker_rack=rack_pad,
+        broker_host=host_pad,
+        broker_capacity=cap_pad,
+        broker_alive=np.concatenate([np.asarray(state.broker_alive), false_pad]),
+        broker_new=np.concatenate([np.asarray(state.broker_new), false_pad]),
+        broker_demoted=np.concatenate(
+            [np.asarray(state.broker_demoted), false_pad]
+        ),
+        num_hosts=state.num_hosts + pad,
+    )
+
+
+def unpad_brokers(
+    state: ClusterArrays, num_brokers: int, num_hosts: int
+) -> ClusterArrays:
+    """Slice a broker-axis-padded state back to its logical size (host-side).
+
+    The inverse of :func:`pad_brokers` for states whose padding stayed inert
+    (no replica ever moves to a dead zero-capacity slot).  Only the broker-axis
+    leaves are materialized on host; replica/partition leaves pass through
+    untouched, so this costs a few tiny fetches and zero compiled dispatches.
+    """
+    import numpy as np
+
+    if state.num_brokers == num_brokers:
+        return state
+
+    def cut(x):
+        return jnp.asarray(np.asarray(x)[:num_brokers])
+
+    return state.replace(
+        broker_rack=cut(state.broker_rack),
+        broker_host=cut(state.broker_host),
+        broker_capacity=cut(state.broker_capacity),
+        broker_alive=cut(state.broker_alive),
+        broker_new=cut(state.broker_new),
+        broker_demoted=cut(state.broker_demoted),
+        num_hosts=num_hosts,
+    )
+
+
+def stack_arrays(per: Sequence[ClusterArrays]) -> ClusterArrays:
+    """Stack same-shape states leaf-wise into one batched ``ClusterArrays``.
+
+    Every array leaf gains a leading scenario axis of size ``len(per)``;
+    static metadata (rack/topic/host counts) is shared — the stacked pytree is
+    a valid ``jax.vmap`` operand (the CvxCluster batch-allocation layout)."""
+    if not per:
+        raise ValueError("stack_arrays needs at least one state")
+    fields = {}
+    for f in dataclasses.fields(ClusterArrays):
+        v0 = getattr(per[0], f.name)
+        if f.metadata.get("pytree_node", True) is False or isinstance(v0, int):
+            fields[f.name] = v0
+            continue
+        fields[f.name] = jnp.stack([getattr(p, f.name) for p in per])
+    return ClusterArrays(**fields)
+
+
+def index_arrays(states: ClusterArrays, i: int) -> ClusterArrays:
+    """Select scenario ``i`` out of a :func:`stack_arrays`-stacked pytree."""
+    return jax.tree_util.tree_map(lambda x: x[i], states)
 
 
 # ---------------------------------------------------------------------------

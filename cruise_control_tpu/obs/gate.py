@@ -19,7 +19,9 @@ Exit codes: 0 pass, 1 regression/timeout, 2 infrastructure error (missing
 baseline, unknown tier).  Thresholds: >25 % wall regression (after an absolute
 noise floor), any hard-violation increase, any dispatch-count increase over
 the gate baseline (+2 over the flagship bench, whose dispatch layout may lag a
-round), or a balancedness drop >1.0 fail the gate.  ``CC_TPU_GATE_WALL_SLACK``
+round), a balancedness drop >1.0, or ANY XLA compile event during the timed
+warm run (warm run ⇒ zero compiles — the bucketed-shape contract) fail the
+gate.  ``CC_TPU_GATE_WALL_SLACK``
 multiplies the wall allowance for shared/noisy CI runners — dispatch and
 violation gates stay exact everywhere.
 
@@ -203,7 +205,13 @@ def run_tier(name: str, inject_sleep_s: float = 0.0) -> dict:
     _force_cpu_platform()
     import jax
 
+    from cruise_control_tpu.core.compile_cache import configure_compile_cache
     from cruise_control_tpu.obs.recorder import RECORDER
+
+    # env-driven (CC_TPU_COMPILE_CACHE): CI persists the directory across
+    # runs, so gate tiers deserialize the solver programs instead of paying
+    # the cold compile every push; a no-op when unset
+    configure_compile_cache()
 
     opt, state, ctx = tier.build()
     t0 = time.monotonic()
@@ -228,6 +236,13 @@ def run_tier(name: str, inject_sleep_s: float = 0.0) -> dict:
     # regression the gate refuses
     trace = next(iter(RECORDER.recent(1, kind="optimize")), None)
     span_dispatch_sum = trace.total_dispatches if trace else -1
+    # warm-recompile accounting: the newest optimize trace after a warm run
+    # carries exactly the XLA compiles that run caused — the bucketed shapes
+    # and shared executables mean a warm run must cause NONE (single-run
+    # tiers report None: their one measured run is the cold compile itself)
+    warm_compile_events = (
+        len(trace.compile_events) if (tier.warm_runs and trace) else None
+    )
     return {
         "tier": name,
         "platform": jax.default_backend(),
@@ -241,6 +256,7 @@ def run_tier(name: str, inject_sleep_s: float = 0.0) -> dict:
         "total_moves": result.total_moves,
         "num_goals": len(result.goal_reports),
         "compile_s": round(compile_s, 3),
+        "warm_compile_events": warm_compile_events,
     }
 
 
@@ -301,6 +317,18 @@ def compare(
         failures.append(
             f"{tier}: flight-recorder span dispatches {span_sum} != reported "
             f"num_dispatches {measured['num_dispatches']} (recorder drift)"
+        )
+
+    # absolute, baseline-independent (mirrors the dispatch-growth check): the
+    # timed warm run re-executes programs the cold run compiled — any compile
+    # event in its flight record means a shape/static-arg drifted between
+    # identical calls, the exact regression the bucketing layer exists to
+    # prevent
+    warm_c = measured.get("warm_compile_events")
+    if warm_c:
+        failures.append(
+            f"{tier}: {warm_c} XLA compile event(s) during the timed warm run "
+            "(warm run ⇒ zero compiles)"
         )
     return failures
 

@@ -37,6 +37,11 @@ class ShardedGoalOptimizer(GoalOptimizer):
         self.mesh = mesh if mesh is not None else solver_mesh()
 
     def optimize(self, state: ClusterArrays, ctx: GoalContext, maps=None, **kw):
+        # bucket BEFORE sharding: padding is host-side numpy, so running it on
+        # an already-sharded state would gather every leaf back to the host and
+        # hand the solver unsharded arrays
+        state, ctx, unbucket = self._bucketed(state, ctx)
         state = shard_state(state, self.mesh)
         ctx = replicate(ctx, self.mesh)
-        return super().optimize(state, ctx, maps=maps, **kw)
+        final, result = self._optimize_core(state, ctx, maps=maps, **kw)
+        return unbucket(final), result

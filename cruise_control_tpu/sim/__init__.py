@@ -16,9 +16,12 @@ Layers:
 
 * :mod:`sim.scenario` — the declarative spec + padded, bucketed batch builder;
 * :mod:`sim.batch`    — single-dispatch fast sweep (violations/balancedness/
-  movement floor/satisfiability) and the deep per-scenario ``optimize()`` path;
+  movement floor/satisfiability) and the deep path: the FULL goal optimizer
+  vmapped over the scenario axis (``GoalOptimizer.batched_optimize`` — B
+  complete optimizations in ~#goals + 4 dispatches);
 * :mod:`sim.planner`  — capacity bisection returning a populated
-  :class:`ProvisionRecommendation`.
+  :class:`ProvisionRecommendation`, with optional batched full-solver
+  verification of the pinned edge (``deep_verify``).
 """
 
 from cruise_control_tpu.sim.scenario import (

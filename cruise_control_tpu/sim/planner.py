@@ -107,11 +107,27 @@ def plan_capacity(
     hard_ids: Sequence[int] = G.HARD_GOALS,
     max_extra_brokers: Optional[int] = None,
     chunk: int = 64,
+    deep_verify: bool = False,
+    deep_window: int = 3,
 ) -> CapacityPlan:
     """Bisect broker count over the batched evaluator.
 
     ``chunk`` bounds the scenarios per dispatch; ``max_extra_brokers`` caps the
-    search above the current count (default: double the cluster, floor 8)."""
+    search above the current count (default: double the cluster, floor 8).
+
+    ``deep_verify`` re-checks the pinned edge with the FULL goal optimizer:
+    the fast kernel tests necessary conditions only, so a count it calls
+    satisfiable may still leave residual hard violations after a real
+    optimization.  The ``deep_window`` counts from the edge upward run as ONE
+    batched deep solve (``sim.batch.deep_sweep`` over
+    ``GoalOptimizer.batched_optimize`` — ~#goals + 4 dispatches for the whole
+    window); if the optimizer needs more brokers than the edge, the plan and
+    recommendation move up to the verified count.  A fully-refuted window is
+    extended upward once; if the optimizer refutes everything probed, the
+    plan floor moves past the refuted range (``confirmed: false`` in
+    ``sweep["deep_verify"]`` marks the count as a floor, not a verified
+    minimum) — or to the unsatisfiable branch when the refutations reach the
+    search cap."""
     from cruise_control_tpu.obs import recorder as obs
 
     token = obs.start_trace("capacity_plan")
@@ -190,6 +206,64 @@ def plan_capacity(
         span_lo, span_hi = lo_unsat + 1, hi_sat - 1
 
     min_brokers = hi_sat
+
+    deep_meta: Optional[dict] = None
+    if deep_verify and min_brokers is not None:
+        from cruise_control_tpu.sim.batch import deep_sweep
+
+        deep_counts: List[int] = []
+        deep_sat: List[bool] = []
+        deep_dispatches = 0
+        win_lo = min_brokers
+        # the edge window, extended once upward if the optimizer refutes all
+        # of it (the fast kernel is necessary-conditions-only, so the true
+        # minimum can sit past the first window)
+        for _ in range(2):
+            counts = list(range(win_lo, min(win_lo + deep_window, hi + 1)))
+            if not counts:
+                break
+            scs = [_count_scenario(alive_desc, B0, c, load_factor) for c in counts]
+            d0 = time.monotonic()
+            deep = deep_sweep(
+                base, scs,
+                constraint=constraint, goal_ids=goal_ids, hard_ids=hard_ids,
+                bucket_brokers=bucket,
+            )
+            deep_dispatches += deep.num_dispatches
+            spans.append(
+                obs.Span(
+                    "deep-verify", "sweep", time.monotonic() - d0,
+                    deep.num_dispatches, attrs={"counts": counts},
+                )
+            )
+            deep_counts += counts
+            deep_sat += [v.satisfiable for v in deep.scenarios]
+            if any(deep_sat):
+                break
+            win_lo = counts[-1] + 1
+        dispatches += deep_dispatches
+        sat_counts = [c for c, s in zip(deep_counts, deep_sat) if s]
+        deep_min = min(sat_counts) if sat_counts else None
+        deep_meta = {
+            "counts": deep_counts,
+            "deep_min_brokers": deep_min,
+            "num_dispatches": deep_dispatches,
+            "confirmed": deep_min == min_brokers,
+        }
+        if deep_min is not None and deep_min > min_brokers:
+            # the optimizer needs more than the necessary-conditions floor —
+            # the verified count is the honest recommendation
+            min_brokers = deep_min
+        elif deep_min is None and deep_counts:
+            # the optimizer refuted EVERY probed count: the true minimum lies
+            # past the verified range.  Move the plan floor past it (the
+            # refutations are hard evidence), or declare the range
+            # unsatisfiable when the refutations reach the search cap — never
+            # recommend a count the verification just demonstrated failing.
+            min_brokers = (
+                deep_counts[-1] + 1 if deep_counts[-1] < hi else None
+            )
+
     racks_in_use = len(set(np.asarray(base.broker_rack)[alive].tolist()))
     sweep_meta = {
         "scenarios_evaluated": len(probes),
@@ -199,6 +273,8 @@ def plan_capacity(
         "current_brokers": B0,
         "bucket_brokers": bucket,
     }
+    if deep_meta is not None:
+        sweep_meta["deep_verify"] = deep_meta
 
     if min_brokers is None:
         needed = max((p.min_brokers_needed for p in probes), default=hi + 1)

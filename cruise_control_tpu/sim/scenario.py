@@ -46,21 +46,12 @@ from typing import List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from cruise_control_tpu.analyzer import goals_base as G
-from cruise_control_tpu.core.resources import NUM_RESOURCES
-from cruise_control_tpu.model.arrays import ClusterArrays
-
-#: floor of the broker-shape bucket ladder (tiny test clusters share one shape)
-MIN_BROKER_BUCKET = 8
-
-
-def broker_bucket(num_brokers: int) -> int:
-    """Bucketed broker-axis size: next power of two ≥ ``num_brokers``.
-
-    The ladder (8, 16, 32, …) keeps the set of compiled sweep shapes small:
-    every scenario over a 100-broker base with up to 28 added brokers lands in
-    the same 128-wide executable."""
-    n = max(int(num_brokers), MIN_BROKER_BUCKET)
-    return 1 << (n - 1).bit_length()
+from cruise_control_tpu.model import arrays as A
+from cruise_control_tpu.model.arrays import (  # noqa: F401  (re-exported API)
+    MIN_BROKER_BUCKET,
+    ClusterArrays,
+    broker_bucket,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,10 +174,10 @@ def apply_scenario(
 
     ``bucket_brokers`` (default :func:`broker_bucket` of brokers-after-add)
     fixes the padded broker dimension so differently-sized scenarios share one
-    compiled evaluator.  Pure numpy; returns a host-backed pytree (jax moves
-    it to device at dispatch)."""
-    import jax.numpy as jnp
-
+    compiled evaluator.  Pure numpy end to end — the returned pytree's leaves
+    ARE numpy arrays (jax converts at the dispatch boundary); eagerly
+    device_put-ing ~20 leaves per scenario costs more than a whole batched
+    goal step at sweep scale."""
     sc.validate(base)
     B = base.num_brokers
     B_new = B + sc.add_brokers
@@ -196,27 +187,24 @@ def apply_scenario(
             f"bucket_brokers={B_pad} smaller than brokers-after-add={B_new}"
         )
 
-    rack = np.asarray(base.broker_rack)
-    host = np.asarray(base.broker_host)
     cap = np.asarray(base.broker_capacity, dtype=np.float32)
-    alive = np.asarray(base.broker_alive).copy()
-    new = np.asarray(base.broker_new).copy()
-    demoted = np.asarray(base.broker_demoted).copy()
+    alive = np.asarray(base.broker_alive)
 
     # broker-axis padding: slots [B, B_new) are the added brokers, [B_new,
-    # B_pad) inert padding.  Padding is indistinguishable from a dead broker
-    # with zero capacity and no replicas — exactly what every kernel masks.
+    # B_pad) inert padding (model.arrays.pad_brokers — the same helper the
+    # bucketed main optimize path uses), then the add slots are activated.
     pad = B_pad - B
-    rack_pad = np.concatenate([rack, (B + np.arange(pad, dtype=np.int32)) % max(base.num_racks, 1)])
-    host_pad = np.concatenate([host, base.num_hosts + np.arange(pad, dtype=np.int32)])
+    padded = A.pad_brokers(base, B_pad)
+    rack_pad = np.asarray(padded.broker_rack)
+    host_pad = np.asarray(padded.broker_host)
+    cap_pad = np.asarray(padded.broker_capacity, np.float32).copy()
+    alive_pad = np.asarray(padded.broker_alive).copy()
+    new_pad = np.asarray(padded.broker_new).copy()
+    demoted_pad = np.asarray(padded.broker_demoted).copy()
     mean_cap = cap[alive].mean(axis=0) if alive.any() else cap.mean(axis=0)
-    cap_pad = np.concatenate([cap, np.zeros((pad, NUM_RESOURCES), np.float32)])
     cap_pad[B:B_new] = mean_cap[None, :]
-    alive_pad = np.concatenate([alive, np.zeros(pad, bool)])
     alive_pad[B:B_new] = True
-    new_pad = np.concatenate([new, np.zeros(pad, bool)])
     new_pad[B:B_new] = True
-    demoted_pad = np.concatenate([demoted, np.zeros(pad, bool)])
 
     dead = np.zeros(B_pad, bool)
     for b in sc.remove_brokers:
@@ -225,7 +213,7 @@ def apply_scenario(
     for b in sc.kill_brokers:
         killed[int(b)] = True
     if sc.drop_rack is not None:
-        killed[:B] |= rack == int(sc.drop_rack)
+        killed[:B] |= rack_pad[:B] == int(sc.drop_rack)
     alive_pad &= ~(dead | killed)
 
     cap_pad = cap_pad * np.asarray(sc.capacity_factors, np.float32)[None, :]
@@ -268,24 +256,24 @@ def apply_scenario(
     disk_cap = np.asarray(base.disk_capacity, np.float32) * float(sc.capacity_factors[3])
 
     return ClusterArrays(
-        replica_partition=jnp.asarray(np.asarray(base.replica_partition)),
-        replica_broker=jnp.asarray(np.asarray(base.replica_broker)),
-        replica_disk=jnp.asarray(np.asarray(base.replica_disk)),
-        replica_valid=jnp.asarray(np.asarray(base.replica_valid)),
-        base_load=jnp.asarray(base_load),
-        original_broker=jnp.asarray(np.asarray(base.original_broker)),
-        partition_topic=jnp.asarray(ptopic),
-        partition_leader=jnp.asarray(leader),
-        leadership_delta=jnp.asarray(delta),
-        broker_rack=jnp.asarray(rack_pad.astype(np.int32)),
-        broker_host=jnp.asarray(host_pad.astype(np.int32)),
-        broker_capacity=jnp.asarray(cap_pad),
-        broker_alive=jnp.asarray(alive_pad),
-        broker_new=jnp.asarray(new_pad),
-        broker_demoted=jnp.asarray(demoted_pad),
-        disk_broker=jnp.asarray(np.asarray(base.disk_broker)),
-        disk_capacity=jnp.asarray(disk_cap),
-        disk_alive=jnp.asarray(np.asarray(base.disk_alive)),
+        replica_partition=np.asarray(base.replica_partition),
+        replica_broker=np.asarray(base.replica_broker),
+        replica_disk=np.asarray(base.replica_disk),
+        replica_valid=np.asarray(base.replica_valid),
+        base_load=base_load,
+        original_broker=np.asarray(base.original_broker),
+        partition_topic=ptopic,
+        partition_leader=leader,
+        leadership_delta=delta,
+        broker_rack=rack_pad.astype(np.int32),
+        broker_host=host_pad.astype(np.int32),
+        broker_capacity=cap_pad,
+        broker_alive=alive_pad,
+        broker_new=new_pad,
+        broker_demoted=demoted_pad,
+        disk_broker=np.asarray(base.disk_broker),
+        disk_capacity=disk_cap,
+        disk_alive=np.asarray(base.disk_alive),
         num_racks=base.num_racks,
         num_topics=base.num_topics,
         num_hosts=base.num_hosts + pad,
@@ -302,23 +290,13 @@ def build_batch(
     The bucket is the max brokers-after-add over the batch, rounded up the
     bucket ladder (or an explicit ``bucket_brokers`` override — the bucket-
     invariance contract says verdicts don't depend on it)."""
-    import jax.numpy as jnp
-
     if not scenarios:
         raise ValueError("build_batch needs at least one scenario")
     scenarios = tuple(scenarios)
     B_need = max(base.num_brokers + s.add_brokers for s in scenarios)
     B_pad = broker_bucket(B_need) if bucket_brokers is None else int(bucket_brokers)
     per = [apply_scenario(base, s, bucket_brokers=B_pad) for s in scenarios]
-
-    fields = {}
-    for f in dataclasses.fields(ClusterArrays):
-        v0 = getattr(per[0], f.name)
-        if f.metadata.get("pytree_node", True) is False or isinstance(v0, int):
-            fields[f.name] = v0
-            continue
-        fields[f.name] = jnp.stack([getattr(p, f.name) for p in per])
-    states = ClusterArrays(**fields)
+    states = A.stack_arrays(per)
     return ScenarioBatch(
         states=states,
         scenarios=scenarios,

@@ -28,7 +28,11 @@ jax.config.update("jax_platforms", "cpu")
 # the AOT loader deserializes cached executables with a machine-feature
 # mismatch ("+prefer-no-scatter ... could lead to SIGILL") and has segfaulted
 # inside compilation_cache.get_executable_and_time mid-suite.  Recompiling is
-# slower but reliable.
+# slower but reliable.  The suite also constructs CruiseControlTpuApp, whose
+# shell wires core.compile_cache from $CC_TPU_COMPILE_CACHE — strip the var so
+# an ambient setting (CI exports it for the bench steps) cannot enable the
+# real cache mid-suite through the app tests.
+os.environ.pop("CC_TPU_COMPILE_CACHE", None)
 
 import pytest  # noqa: E402
 

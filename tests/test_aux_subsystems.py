@@ -428,3 +428,65 @@ class TestSensorWiring:
         assert REGISTRY.timer(CLUSTER_MODEL_CREATION_TIMER).count >= 1
         assert REGISTRY.timer(PROPOSAL_COMPUTATION_TIMER).count >= 1
         assert REGISTRY.gauge(MONITORED_PARTITIONS_GAUGE).snapshot() > 0
+
+
+class TestCompileCache:
+    """configure_compile_cache wiring (the real cache is never enabled in the
+    suite — this host's AOT loader can SIGILL on deserialize, conftest.py)."""
+
+    def test_noop_without_path_or_env(self, monkeypatch):
+        from cruise_control_tpu.core.compile_cache import (
+            COMPILE_CACHE_ENV,
+            configure_compile_cache,
+        )
+
+        monkeypatch.delenv(COMPILE_CACHE_ENV, raising=False)
+        calls = []
+        assert configure_compile_cache(_config_update=lambda *a: calls.append(a)) is None
+        assert calls == []
+
+    def test_explicit_path_sets_jax_cache_config(self, tmp_path, monkeypatch):
+        from cruise_control_tpu.core.compile_cache import (
+            COMPILE_CACHE_ENV,
+            configure_compile_cache,
+        )
+
+        monkeypatch.delenv(COMPILE_CACHE_ENV, raising=False)
+        target = tmp_path / "cc-cache"
+        calls = {}
+        out = configure_compile_cache(
+            str(target), _config_update=lambda k, v: calls.__setitem__(k, v)
+        )
+        assert out == str(target)
+        assert target.is_dir(), "the cache directory is created eagerly"
+        assert calls["jax_compilation_cache_dir"] == str(target)
+        # every program persists: no size / compile-time floors
+        assert calls["jax_persistent_cache_min_entry_size_bytes"] == -1
+        assert calls["jax_persistent_cache_min_compile_time_secs"] == 0.0
+
+    def test_env_fallback_and_user_expansion(self, tmp_path, monkeypatch):
+        from cruise_control_tpu.core.compile_cache import (
+            COMPILE_CACHE_ENV,
+            configure_compile_cache,
+        )
+
+        monkeypatch.setenv("HOME", str(tmp_path))
+        monkeypatch.setenv(COMPILE_CACHE_ENV, "~/xla-cache")
+        calls = {}
+        out = configure_compile_cache(
+            _config_update=lambda k, v: calls.__setitem__(k, v)
+        )
+        assert out == str(tmp_path / "xla-cache")
+        assert (tmp_path / "xla-cache").is_dir()
+
+    def test_app_config_key_overrides_env(self, monkeypatch, tmp_path):
+        """compile.cache.dir resolves through the merged config registry."""
+        from cruise_control_tpu.core.config import Config
+        from cruise_control_tpu.core.config_defs import cruise_control_config
+
+        cfg = Config(
+            cruise_control_config(),
+            {"compile.cache.dir": str(tmp_path / "from-config")},
+        )
+        assert cfg.get("compile.cache.dir") == str(tmp_path / "from-config")
+        assert Config(cruise_control_config(), {}).get("compile.cache.dir") == ""

@@ -1,0 +1,94 @@
+"""Acceptance: the batched deep sweep on the config1 cluster.
+
+The ISSUE contract, asserted end to end from the obs flight record:
+
+* a warm 16-scenario ``deep_sweep`` over the full (non-heavy) default goal
+  list on the config1 cluster (3 brokers / 20 partitions — the gate's
+  ``config1`` tier shape) completes in ≤ (#goals + 6) total compiled
+  dispatches with ZERO XLA compile events;
+* its per-scenario verdicts equal the sequential per-scenario loop
+  (``deep_sweep(batched=False)`` — one full ``optimize()`` per scenario).
+
+This lives in its own module so its compile budget (the batched and unbatched
+full-goal-list program sets) does not contend with other modules' executables
+(conftest clears jit caches between modules).
+"""
+
+import pytest
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.obs import RECORDER
+from cruise_control_tpu.sim import Scenario, deep_sweep
+from cruise_control_tpu.synthetic import SyntheticSpec, generate
+
+#: deep_sweep runs GoalOptimizer(enable_heavy_goals=False): the heavy [B,T]
+#: goals drop out of the default list, and the dispatch budget follows
+N_GOALS = len([g for g in G.DEFAULT_GOAL_ORDER if g not in G.HEAVY_GOALS])
+
+
+@pytest.fixture(scope="module")
+def config1():
+    """The gate's config1 tier shape (obs/gate._build_config1)."""
+    spec = SyntheticSpec(
+        num_racks=2, num_brokers=3, num_topics=2, num_partitions=20,
+        replication_factor=2, distribution="exponential", skew_brokers=1,
+        mean_cpu=0.25, mean_disk=0.2, mean_nw_in=0.15, mean_nw_out=0.15,
+        seed=3,
+    )
+    return generate(spec)[0]
+
+
+def sixteen_scenarios():
+    """16 mixed hypotheticals, all inside the 8-broker bucket (adds ≤ 3)."""
+    out = []
+    for i in range(16):
+        out.append(
+            Scenario(
+                name=f"s{i}",
+                add_brokers=i % 4,
+                kill_brokers=(i % 3,) if i % 5 == 0 else (),
+                load_factor=1.0 + 0.05 * i,
+                capacity_factors=(1.0, 1.0, 1.0, 1.5) if i % 7 == 0 else
+                                 (1.0, 1.0, 1.0, 1.0),
+            )
+        )
+    return out
+
+
+class TestConfig1DeepSweepAcceptance:
+    def test_warm_16_scenario_sweep_meets_dispatch_and_compile_budget(
+        self, config1
+    ):
+        scs = sixteen_scenarios()
+        seq = deep_sweep(config1, scs, batched=False)     # the reference path
+        deep_sweep(config1, scs)                           # batched warmup
+        r = deep_sweep(config1, scs)                       # measured warm sweep
+
+        # one goal-order group ⇒ #goals + 4 dispatches, inside the +6 budget
+        assert r.sweep_size == 16
+        assert r.num_dispatches == N_GOALS + 4
+        assert r.num_dispatches <= N_GOALS + 6
+        assert r.bucket_hit
+        # vs B × (#goals + 4) for the sequential loop
+        assert seq.num_dispatches == 16 * (N_GOALS + 4)
+
+        # the obs flight record is the evidence, not the return value
+        trace = RECORDER.recent(limit=1, kind="simulate")[0]
+        assert trace.attrs["deep"] is True
+        assert trace.attrs["sweep_size"] == 16
+        assert trace.attrs["num_dispatches"] == r.num_dispatches
+        assert trace.total_dispatches == r.num_dispatches
+        assert trace.compile_events == [], (
+            "warm batched deep sweep must cause zero XLA compiles: "
+            + str(trace.compile_events)
+        )
+
+        # per-scenario results equal the sequential path
+        for v, w in zip(r.scenarios, seq.scenarios):
+            assert v.name == w.name
+            assert v.violations == w.violations, v.name
+            assert v.balancedness == w.balancedness, v.name
+            assert v.movement == w.movement, v.name
+            assert v.verdict == w.verdict, v.name
+            assert v.provision_status == w.provision_status, v.name
+            assert v.satisfiable == w.satisfiable, v.name

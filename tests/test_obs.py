@@ -350,6 +350,18 @@ class TestGateCompare:
         fails = compare(BASE, _measured(span_dispatch_sum=15))
         assert any("recorder drift" in f for f in fails)
 
+    def test_fail_on_warm_recompile(self):
+        # absolute check, independent of the committed baseline: ANY compile
+        # event in the timed warm run's flight record fails the gate
+        fails = compare(BASE, _measured(warm_compile_events=2))
+        assert any("compile event" in f for f in fails)
+
+    def test_zero_or_absent_warm_compiles_pass(self):
+        assert compare(BASE, _measured(warm_compile_events=0)) == []
+        # single-run tiers (mesh8) report None — no warm run to judge
+        assert compare(BASE, _measured(warm_compile_events=None)) == []
+        assert compare(BASE, _measured()) == []
+
     def test_wall_slack_loosens_only_wall(self):
         m = _measured(wall_s=2.0, residual_hard_violations=1.0)
         fails = compare(BASE, m, wall_slack=3.0)

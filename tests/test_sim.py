@@ -275,6 +275,223 @@ class TestDeepSweep:
         }
 
 
+class TestBatchedOptimize:
+    """The vmapped full solver (GoalOptimizer.batched_optimize) and the
+    batched deep_sweep built on it.  Same goal subset and 16-broker bucket as
+    TestDeepSweep, so the module compiles each program set once."""
+
+    GOALS = TestDeepSweep.GOALS
+    HARD = (G.RACK_AWARE, G.DISK_CAPACITY)
+
+    def _opt(self, **kw):
+        return GoalOptimizer(
+            goal_ids=self.GOALS, hard_ids=self.HARD,
+            enable_heavy_goals=False, **kw,
+        )
+
+    def test_b1_bit_equal_to_direct_optimize(self):
+        from cruise_control_tpu.model.arrays import stack_arrays
+
+        base = small_cluster()
+        sc = Scenario(name="kill1", kill_brokers=(1,), load_factor=1.2)
+        bucket = broker_bucket(base.num_brokers)
+        mut = apply_scenario(base, sc, bucket_brokers=bucket)
+        ctx = GoalContext.build(base.num_topics, bucket)
+        final, direct = self._opt(bucket_brokers=False).optimize(mut, ctx)
+        states, batched = self._opt(bucket_brokers=False).batched_optimize(
+            stack_arrays([mut]), ctx
+        )
+        r = batched.results[0]
+        assert r.violations_before == direct.violations_before
+        assert r.violations_after == direct.violations_after
+        assert r.balancedness_score == direct.balancedness_score
+        assert dataclasses.asdict(r.movement) == dataclasses.asdict(direct.movement)
+        assert r.provision.status == direct.provision.status
+        # per-goal moves are exact (extra vmap rounds on a converged lane are
+        # zero-move by construction; only round counters may absorb them)
+        assert [g.moves_applied for g in r.goal_reports] == [
+            g.moves_applied for g in direct.goal_reports
+        ]
+        assert [g.violations_after for g in r.goal_reports] == [
+            g.violations_after for g in direct.goal_reports
+        ]
+        # the dispatch budget is the fused single-optimize budget: #goals + 4
+        assert batched.num_dispatches == len(self.GOALS) + 4 == direct.num_dispatches
+        # and the PLACEMENT is bit-equal, not just the scores
+        np.testing.assert_array_equal(
+            np.asarray(states.replica_broker)[0], np.asarray(final.replica_broker)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(states.partition_leader)[0],
+            np.asarray(final.partition_leader),
+        )
+
+    def test_deep_sweep_batched_matches_sequential(self):
+        """The satellite contract: batched deep_sweep verdicts/balancedness/
+        moves equal the sequential per-scenario loop on a mixed scenario set
+        (including a custom-goal-order scenario, which forms its own group)."""
+        base = small_cluster()
+        scs = [
+            Scenario(name="kill0", kill_brokers=(0,)),
+            Scenario(name="add2", add_brokers=2, load_factor=1.4),
+            Scenario(name="heavy", load_factor=2.0),
+            Scenario(name="noop"),
+            Scenario(name="perm", kill_brokers=(1,),
+                     goal_order=(G.DISK_CAPACITY, G.RACK_AWARE)),
+        ]
+        rb = deep_sweep(base, scs, goal_ids=self.GOALS, hard_ids=self.HARD)
+        rs = deep_sweep(
+            base, scs, goal_ids=self.GOALS, hard_ids=self.HARD, batched=False
+        )
+        assert rb.sweep_size == rs.sweep_size == 5
+        for v, w in zip(rb.scenarios, rs.scenarios):
+            assert v.name == w.name
+            assert v.violations == w.violations, v.name
+            assert v.balancedness == w.balancedness, v.name
+            assert v.movement == w.movement, v.name
+            assert v.verdict == w.verdict, v.name
+            assert v.provision_status == w.provision_status, v.name
+        # two goal-order groups: default (4 scenarios) + permuted (1)
+        assert rb.num_dispatches == (len(self.GOALS) + 4) + (2 + 4)
+        assert rb.num_dispatches < rs.num_dispatches
+
+    def test_warm_deep_sweep_dispatches_and_zero_compiles(self):
+        base = small_cluster()
+        scs = [
+            Scenario(name=f"s{i}", add_brokers=i % 3, load_factor=1.0 + 0.1 * i)
+            for i in range(6)
+        ]
+        deep_sweep(base, scs, goal_ids=self.GOALS, hard_ids=self.HARD)  # warmup
+        r = deep_sweep(base, scs, goal_ids=self.GOALS, hard_ids=self.HARD)
+        assert r.num_dispatches == len(self.GOALS) + 4
+        assert r.bucket_hit, "second identical deep sweep must be a bucket hit"
+        trace = RECORDER.recent(limit=1, kind="simulate")[0]
+        assert trace.attrs["num_dispatches"] == r.num_dispatches
+        assert trace.total_dispatches == r.num_dispatches
+        assert trace.attrs["deep"] is True
+        assert trace.compile_events == [], (
+            "warm batched deep sweep must not recompile: "
+            + str(trace.compile_events)
+        )
+
+    def test_donation_keeps_caller_state_reusable(self):
+        """donate_argnums on the hot jits must never invalidate a CALLER's
+        pytree: the first state-consuming dispatch is non-donating, so
+        re-optimizing the same input (gate warm runs, benches) stays legal."""
+        from cruise_control_tpu.model.arrays import stack_arrays
+
+        base = small_cluster()
+        bucket = broker_bucket(base.num_brokers)
+        mut = apply_scenario(base, Scenario(name="noop"), bucket_brokers=bucket)
+        ctx = GoalContext.build(base.num_topics, bucket)
+        opt = self._opt(bucket_brokers=False)
+        _, r1 = opt.optimize(mut, ctx)
+        _, r2 = opt.optimize(mut, ctx)          # same input pytree again
+        assert r1.violations_after == r2.violations_after
+        assert r1.balancedness_score == r2.balancedness_score
+
+        stacked = stack_arrays([mut, mut])
+        _, b1 = opt.batched_optimize(stacked, ctx)
+        _, b2 = opt.batched_optimize(stacked, ctx)   # stacked input reused
+        assert [x.violations_after for x in b1.results] == [
+            x.violations_after for x in b2.results
+        ]
+
+    def test_bucketed_main_path_reuses_executables_across_broker_counts(self):
+        """The compile-amortization contract for the MAIN optimize entry: a
+        10-broker and an 11-broker cluster share the 16-bucket, so the second
+        optimize triggers ZERO XLA compiles; the returned state keeps the
+        caller's broker axis; and the padding is inert (same placement as the
+        unbucketed solve)."""
+        from cruise_control_tpu.obs import recorder as obs_rec
+
+        s10 = small_cluster(seed=11)
+        s11 = generate(SyntheticSpec(
+            num_racks=5, num_brokers=11, num_topics=5, num_partitions=50,
+            replication_factor=2, seed=12, **LIGHT,
+        ))[0]
+        opt = self._opt()                       # bucket_brokers defaults ON
+        assert opt.bucket_brokers
+        f10, _ = opt.optimize(
+            s10, GoalContext.build(s10.num_topics, s10.num_brokers)
+        )
+        mark = obs_rec.compile_mark()
+        f11, _ = opt.optimize(
+            s11, GoalContext.build(s11.num_topics, s11.num_brokers)
+        )
+        assert obs_rec.compile_events_since(mark) == [], (
+            "same-bucket optimize must reuse every executable"
+        )
+        assert f10.num_brokers == 10 and f11.num_brokers == 11
+        fu, _ = self._opt(bucket_brokers=False).optimize(
+            s10, GoalContext.build(s10.num_topics, s10.num_brokers)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(f10.replica_broker), np.asarray(fu.replica_broker)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(f10.partition_leader), np.asarray(fu.partition_leader)
+        )
+
+
+class TestPlannerDeepVerify:
+    GOALS = TestDeepSweep.GOALS
+    HARD = (G.RACK_AWARE, G.DISK_CAPACITY)
+
+    def test_deep_verify_confirms_edge_and_reports(self):
+        base = small_cluster()
+        # max_extra_brokers=6 keeps every probe inside the module's shared
+        # 16-broker bucket (10 base slots + 6 adds)
+        plan = plan_capacity(
+            base, load_factor=1.0, goal_ids=self.GOALS, hard_ids=self.HARD,
+            max_extra_brokers=6, deep_verify=True,
+        )
+        assert plan.min_brokers is not None
+        meta = plan.recommendation.sweep["deep_verify"]
+        assert meta["counts"][0] >= plan.min_brokers - len(meta["counts"])
+        assert meta["deep_min_brokers"] is not None
+        # the full-solver pass is batched: one goal walk for the whole window
+        assert meta["num_dispatches"] <= len(self.GOALS) + 6
+        if meta["confirmed"]:
+            assert meta["deep_min_brokers"] == plan.min_brokers
+        else:
+            # the optimizer needed more than the necessary-conditions floor —
+            # the plan moved up to the verified count
+            assert plan.min_brokers == meta["deep_min_brokers"]
+
+    def test_all_refuted_window_moves_plan_past_it(self, monkeypatch):
+        """Regression: when the full optimizer refutes EVERY probed count, the
+        plan must not keep recommending the refuted fast-kernel edge — the
+        floor moves past the verified range (marked unconfirmed)."""
+        import types
+
+        import cruise_control_tpu.sim.batch as sim_batch
+
+        windows = []
+
+        def refute_everything(base_, scs, **kw):
+            windows.append([s.name for s in scs])
+            return types.SimpleNamespace(
+                scenarios=[
+                    types.SimpleNamespace(satisfiable=False) for _ in scs
+                ],
+                num_dispatches=7,
+            )
+
+        monkeypatch.setattr(sim_batch, "deep_sweep", refute_everything)
+        base = small_cluster()
+        plan = plan_capacity(
+            base, load_factor=1.0, goal_ids=self.GOALS, hard_ids=self.HARD,
+            max_extra_brokers=6, deep_verify=True,
+        )
+        assert len(windows) == 2, "a fully-refuted window is extended once"
+        meta = plan.recommendation.sweep["deep_verify"]
+        assert meta["deep_min_brokers"] is None
+        assert meta["confirmed"] is False
+        # the plan floor sits past every refuted count
+        assert plan.min_brokers == meta["counts"][-1] + 1
+
+
 class TestPlanner:
     def test_underprovisioned_monotone_and_sweep_backed(self):
         # genuinely under-provisioned: heavy load on few brokers
