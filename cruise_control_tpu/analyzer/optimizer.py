@@ -45,6 +45,7 @@ from cruise_control_tpu.core.resources import Resource
 from cruise_control_tpu.model import arrays as A
 from cruise_control_tpu.model import stats as S
 from cruise_control_tpu.model.arrays import ClusterArrays
+from cruise_control_tpu.obs.profiler import PROFILER, profile_jit
 
 
 FAST_MODE_MAX_ROUNDS = 64
@@ -414,11 +415,17 @@ def _phase_loop(state, ctx, *, round_fn, max_rounds, enable_heavy, prior_ids, ad
 #:    layer; a no-op where the backend lacks donation support);
 #:  - ``*_b``/``*_b_don`` — ``jax.vmap`` over a stacked scenario axis with a
 #:    shared context: the whole-batch programs behind ``batched_optimize``.
+#: every jit flavor registers with the executable profiler (obs/profiler.py):
+#: call counts, attributed compiles and HLO FLOPs/bytes per compiled program —
+#: pure host bookkeeping, no extra dispatches or compiles on any path
 _PHASE_STATICS = ("round_fn", "max_rounds", "enable_heavy", "prior_ids", "admit_ids")
-_phase = partial(jax.jit, static_argnames=_PHASE_STATICS)(_phase_loop)
-_phase_don = partial(
-    jax.jit, static_argnames=_PHASE_STATICS, donate_argnums=(0,)
-)(_phase_loop)
+_phase = profile_jit(
+    "optimizer.phase", partial(jax.jit, static_argnames=_PHASE_STATICS)(_phase_loop)
+)
+_phase_don = profile_jit(
+    "optimizer.phase",
+    partial(jax.jit, static_argnames=_PHASE_STATICS, donate_argnums=(0,))(_phase_loop),
+)
 
 
 def _vmap_step(fn):
@@ -438,10 +445,16 @@ def _vmap_step(fn):
     return run
 
 
-_phase_b = partial(jax.jit, static_argnames=_PHASE_STATICS)(_vmap_step(_phase_loop))
-_phase_b_don = partial(
-    jax.jit, static_argnames=_PHASE_STATICS, donate_argnums=(0,)
-)(_vmap_step(_phase_loop))
+_phase_b = profile_jit(
+    "optimizer.phase_batched",
+    partial(jax.jit, static_argnames=_PHASE_STATICS)(_vmap_step(_phase_loop)),
+)
+_phase_b_don = profile_jit(
+    "optimizer.phase_batched",
+    partial(jax.jit, static_argnames=_PHASE_STATICS, donate_argnums=(0,))(
+        _vmap_step(_phase_loop)
+    ),
+)
 
 
 _GOAL_STEP_STATICS = (
@@ -509,13 +522,22 @@ def _goal_step_fn(
     return state, rounds, moves, before, after
 
 
-_goal_step = partial(jax.jit, static_argnames=_GOAL_STEP_STATICS)(_goal_step_fn)
-_goal_step_don = partial(
-    jax.jit, static_argnames=_GOAL_STEP_STATICS, donate_argnums=(0,)
-)(_goal_step_fn)
-_goal_step_b_don = partial(
-    jax.jit, static_argnames=_GOAL_STEP_STATICS, donate_argnums=(0,)
-)(_vmap_step(_goal_step_fn))
+_goal_step = profile_jit(
+    "optimizer.goal_step",
+    partial(jax.jit, static_argnames=_GOAL_STEP_STATICS)(_goal_step_fn),
+)
+_goal_step_don = profile_jit(
+    "optimizer.goal_step",
+    partial(jax.jit, static_argnames=_GOAL_STEP_STATICS, donate_argnums=(0,))(
+        _goal_step_fn
+    ),
+)
+_goal_step_b_don = profile_jit(
+    "optimizer.goal_step_batched",
+    partial(jax.jit, static_argnames=_GOAL_STEP_STATICS, donate_argnums=(0,))(
+        _vmap_step(_goal_step_fn)
+    ),
+)
 
 
 def _assigner_step_fn(state, ctx, *, max_rf, enable_heavy):
@@ -539,13 +561,22 @@ def _assigner_step_fn(state, ctx, *, max_rf, enable_heavy):
 
 
 _ASSIGNER_STATICS = ("max_rf", "enable_heavy")
-_assigner_step = partial(jax.jit, static_argnames=_ASSIGNER_STATICS)(_assigner_step_fn)
-_assigner_step_don = partial(
-    jax.jit, static_argnames=_ASSIGNER_STATICS, donate_argnums=(0,)
-)(_assigner_step_fn)
-_assigner_step_b_don = partial(
-    jax.jit, static_argnames=_ASSIGNER_STATICS, donate_argnums=(0,)
-)(_vmap_step(_assigner_step_fn))
+_assigner_step = profile_jit(
+    "optimizer.assigner_step",
+    partial(jax.jit, static_argnames=_ASSIGNER_STATICS)(_assigner_step_fn),
+)
+_assigner_step_don = profile_jit(
+    "optimizer.assigner_step",
+    partial(jax.jit, static_argnames=_ASSIGNER_STATICS, donate_argnums=(0,))(
+        _assigner_step_fn
+    ),
+)
+_assigner_step_b_don = profile_jit(
+    "optimizer.assigner_step_batched",
+    partial(jax.jit, static_argnames=_ASSIGNER_STATICS, donate_argnums=(0,))(
+        _vmap_step(_assigner_step_fn)
+    ),
+)
 
 
 def _max_replication_factor(state: ClusterArrays) -> int:
@@ -567,17 +598,23 @@ def _violations_fn(state, ctx, enable_heavy=False, subset=None):
     return G.violations_all(state, ctx, snap, subset=subset)
 
 
-_violations = partial(
-    jax.jit, static_argnames=("enable_heavy", "subset")
-)(_violations_fn)
+_violations = profile_jit(
+    "optimizer.violations",
+    partial(jax.jit, static_argnames=("enable_heavy", "subset"))(_violations_fn),
+)
 
 
-@partial(jax.jit, static_argnames=("enable_heavy", "subset"))
-def _violations_b(states, ctx, enable_heavy=False, subset=None):
+def _violations_b_fn(states, ctx, enable_heavy=False, subset=None):
     """[S, NUM_GOALS] violation counts for a stacked scenario axis."""
     return jax.vmap(
         lambda s: _violations_fn(s, ctx, enable_heavy, subset)
     )(states)
+
+
+_violations_b = profile_jit(
+    "optimizer.violations_batched",
+    partial(jax.jit, static_argnames=("enable_heavy", "subset"))(_violations_b_fn),
+)
 
 
 # -- real per-goal durations without host sync --------------------------------------
@@ -790,6 +827,7 @@ class GoalOptimizer:
         from cruise_control_tpu.obs import recorder as obs
 
         trace_token = obs.start_trace("optimize")
+        cost_mark = PROFILER.mark()
         t0 = time.monotonic()
         heavy = self.enable_heavy_goals
         fused = self.fuse_goal_dispatch
@@ -1081,6 +1119,9 @@ class GoalOptimizer:
                 "num_partitions": state.num_partitions,
                 "num_replicas": state.num_replicas,
                 "movement": dataclasses.asdict(result.movement),
+                # device-cost block (obs/profiler.py): FLOPs/bytes executed by
+                # THIS optimize's dispatches + the HBM watermark at the boundary
+                "cost": PROFILER.cost_since(cost_mark),
                 **obs.mesh_metadata(),
             },
         )
@@ -1118,6 +1159,7 @@ class GoalOptimizer:
         from cruise_control_tpu.obs import recorder as obs
 
         trace_token = obs.start_trace("optimize")
+        cost_mark = PROFILER.mark()
         t0 = time.monotonic()
         heavy = self.enable_heavy_goals
         S = int(states.base_load.shape[0])
@@ -1274,6 +1316,7 @@ class GoalOptimizer:
                 "num_partitions": int(states.partition_topic.shape[-1]),
                 "num_replicas": int(states.replica_partition.shape[-1]),
                 "fast_mode": bool(ctx.fast_mode),
+                "cost": PROFILER.cost_since(cost_mark),
                 **obs.mesh_metadata(),
             },
         )

@@ -28,7 +28,16 @@ _COMMON_PARAMS = [
     {"name": "json", "in": "query", "required": False,
      "schema": {"type": "boolean"},
      "description": "JSON response (always true here; kept for CLI parity)"},
+    {"name": "X-Request-Id", "in": "header", "required": False,
+     "schema": {"type": "string"},
+     "description": ("correlation id attached (as parent_id) to every "
+                     "flight-recorder trace this request causes — user task, "
+                     "optimize, execution; generated and echoed back when "
+                     "absent.  Retrieve the walk with GET /traces?parent_id=")},
 ]
+
+#: endpoints whose 200 body is text/plain, not JSON
+_TEXT_ENDPOINTS = {"METRICS": "Prometheus text exposition format 0.0.4"}
 _ASYNC_PARAMS = [
     {"name": "dryrun", "in": "query", "required": False,
      "schema": {"type": "boolean"},
@@ -76,6 +85,22 @@ _ENDPOINT_PARAMS = {
         {"name": "broker_number", "in": "query", "required": False,
          "schema": {"type": "integer"},
          "description": "cap on extra brokers the capacity sweep may probe"},
+    ],
+    "TRACES": [
+        {"name": "kind", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": ("trace kind filter: optimize | execution | detector "
+                         "| model | simulate | user_task | retry | ...")},
+        {"name": "trace_id", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": "exact trace id"},
+        {"name": "parent_id", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": ("request correlation id (X-Request-Id): returns the "
+                         "user task, optimize and execution traces it caused")},
+        {"name": "limit", "in": "query", "required": False,
+         "schema": {"type": "integer"},
+         "description": "newest-first record cap (default 50)"},
     ],
 }
 
@@ -128,17 +153,25 @@ def generate_openapi() -> Dict[str, Any]:
     for name in sorted(GET_ENDPOINTS | POST_ENDPOINTS):
         method = "get" if name in GET_ENDPOINTS else "post"
         body_schema = RESPONSE_SCHEMAS.get(name)
-        responses: Dict[str, Any] = {
-            "200": {
-                "description": "success",
-                "content": {
-                    "application/json": {
-                        "schema": _schema_to_openapi(body_schema)
-                        if body_schema is not None
-                        else {"type": "object"}
+        if name in _TEXT_ENDPOINTS:
+            content = {
+                "text/plain": {
+                    "schema": {
+                        "type": "string",
+                        "description": _TEXT_ENDPOINTS[name],
                     }
-                },
+                }
             }
+        else:
+            content = {
+                "application/json": {
+                    "schema": _schema_to_openapi(body_schema)
+                    if body_schema is not None
+                    else {"type": "object"}
+                }
+            }
+        responses: Dict[str, Any] = {
+            "200": {"description": "success", "content": content}
         }
         params = list(_COMMON_PARAMS)
         if method == "post":

@@ -101,6 +101,8 @@ _USER_TASK = {
     "Status": str,
     "StartMs": int,
     "?Progress": [dict],
+    #: the creating request's X-Request-Id — GET /TRACES?parent_id=… walks it
+    "?RequestId": str,
 }
 
 #: endpoint name (CruiseControlEndPoint.java:16-39) -> response schema
@@ -110,6 +112,11 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
         "ExecutorState": dict,
         "uptime_s": float,
         "?AnomalyDetectorState": dict,
+        "?Profiler": {
+            "enabled": bool,
+            "executables": [dict],
+            "memory": [dict],
+        },
     },
     "LOAD": {"brokers": [_BROKER_LOAD], "?hosts": [dict]},
     "PARTITION_LOAD": {"records": [dict], "?resource": str},
@@ -186,6 +193,7 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
                     }
                 ],
                 "compile_events": [dict],
+                "?parent_id": (str, None),
                 "schema": int,
             }
         ],

@@ -27,8 +27,13 @@ class Role(enum.IntEnum):
     ADMIN = 2
 
 
-#: Minimum role per endpoint (UserPermissionsManager's mapping).
-VIEWER_ENDPOINTS = {"STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE"}
+#: Minimum role per endpoint (UserPermissionsManager's mapping).  METRICS is
+#: VIEWER-tier: a Prometheus scrape target carries aggregate operational
+#: numbers only (the JMX-exporter posture of the reference deployment).
+VIEWER_ENDPOINTS = {
+    "STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE",
+    "METRICS",
+}
 USER_ENDPOINTS = VIEWER_ENDPOINTS | {"USER_TASKS", "REVIEW_BOARD", "PERMISSIONS"}
 
 

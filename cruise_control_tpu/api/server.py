@@ -4,7 +4,7 @@ Counterpart of the servlet/vertx front-ends (``servlet/CruiseControlEndPoint.jav
 lists the 22 endpoints; dispatch mirrors ``KafkaCruiseControlRequestHandler.doGetOrPost``):
 
 GET  STATE LOAD PARTITION_LOAD PROPOSALS KAFKA_CLUSTER_STATE USER_TASKS
-     REVIEW_BOARD PERMISSIONS BOOTSTRAP TRAIN TRACES
+     REVIEW_BOARD PERMISSIONS BOOTSTRAP TRAIN TRACES METRICS
 POST REBALANCE ADD_BROKER REMOVE_BROKER DEMOTE_BROKER FIX_OFFLINE_REPLICAS
      STOP_PROPOSAL_EXECUTION PAUSE_SAMPLING RESUME_SAMPLING TOPIC_CONFIGURATION
      RIGHTSIZE REMOVE_DISKS ADMIN REVIEW SIMULATE
@@ -12,6 +12,10 @@ POST REBALANCE ADD_BROKER REMOVE_BROKER DEMOTE_BROKER FIX_OFFLINE_REPLICAS
 SIMULATE (no reference counterpart) evaluates a batch of hypothetical clusters
 — broker adds/removals/failures, rack loss, load and capacity scaling — in one
 device dispatch (``sim/``); RIGHTSIZE runs the sweep-backed capacity planner.
+METRICS serves the Prometheus text exposition of the whole telemetry plane
+(``obs/exporter.py``); every request carries a correlation id (inbound
+``X-Request-Id`` or generated) that links its user-task/optimize/execution
+flight-recorder traces — walk them with GET /traces?parent_id=.
 
 Long-running POSTs flow through the :class:`UserTaskManager` (202 + ``User-Task-ID``
 until done), optionally parked in the :class:`Purgatory` when two-step verification
@@ -24,8 +28,9 @@ import dataclasses
 import json
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
@@ -48,8 +53,10 @@ API_PREFIX = "/kafkacruisecontrol/"
 GET_ENDPOINTS = {
     "STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE",
     "USER_TASKS", "REVIEW_BOARD", "PERMISSIONS", "BOOTSTRAP", "TRAIN",
-    "TRACES",
+    "TRACES", "METRICS",
 }
+#: endpoints whose 200 body is plain text, not JSON (Prometheus exposition)
+TEXT_ENDPOINTS = {"METRICS"}
 POST_ENDPOINTS = {
     "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
     "FIX_OFFLINE_REPLICAS", "STOP_PROPOSAL_EXECUTION", "PAUSE_SAMPLING",
@@ -200,12 +207,16 @@ class CruiseControlApp:
 
     def get_state(self, params) -> Tuple[int, dict]:
         from cruise_control_tpu.core.sensors import REGISTRY
+        from cruise_control_tpu.obs.profiler import PROFILER
 
         body = self.cc.state()
         if self.anomaly_manager is not None:
             body["AnomalyDetectorState"] = dataclasses.asdict(self.anomaly_manager.state())
         # sensor families (Sensors.md): timers/gauges/counters per subsystem
         body["Sensors"] = REGISTRY.snapshot()
+        # device-cost surface (obs/profiler.py): per-executable FLOPs/bytes,
+        # call counts, attributed compiles, memory watermark
+        body["Profiler"] = PROFILER.snapshot()
         return 200, body
 
     def get_load(self, params) -> Tuple[int, dict]:
@@ -323,15 +334,33 @@ class CruiseControlApp:
     def get_traces(self, params) -> Tuple[int, dict]:
         """Flight-recorder ring: newest-first solver/executor/detector traces
         (``obs/recorder.py``) — the decision record behind every number the
-        STATE sensors aggregate."""
+        STATE sensors aggregate.  ``parent_id`` filters by the request
+        correlation id (``X-Request-Id``): one id walks request → user task →
+        optimize → execution; ``trace_id`` pins a single record."""
         from cruise_control_tpu.obs import RECORDER
 
         kind = params.get("kind", [None])[0]
+        trace_id = params.get("trace_id", [None])[0]
+        parent_id = params.get("parent_id", [None])[0]
         limit = int(params.get("limit", ["50"])[0])
         return 200, {
-            "traces": [t.to_dict() for t in RECORDER.recent(limit, kind=kind)],
+            "traces": [
+                t.to_dict()
+                for t in RECORDER.recent(
+                    limit, kind=kind, trace_id=trace_id, parent_id=parent_id
+                )
+            ],
             "recorder": RECORDER.snapshot(),
         }
+
+    def get_metrics(self, params) -> Tuple[int, str]:
+        """Prometheus text exposition of the whole telemetry plane
+        (``obs/exporter.py``): every sensor family, flight-recorder and gate
+        summaries, per-executable device cost, device memory.  Plain text —
+        the one endpoint a ``scrape_configs`` stanza points at."""
+        from cruise_control_tpu.obs.exporter import render_prometheus
+
+        return 200, render_prometheus()
 
     def get_train(self, params) -> Tuple[int, dict]:
         start = int(params.get("start", ["0"])[0])
@@ -344,8 +373,15 @@ class CruiseControlApp:
     def _async_op(
         self, endpoint: str, params, work, to_json=_op_result_json
     ) -> Tuple[int, dict, Dict[str, str]]:
+        from cruise_control_tpu.obs import recorder as obs
+
         key = (endpoint, tuple(sorted((k, tuple(v)) for k, v in params.items())))
-        task = self.user_tasks.get_or_create(endpoint, key, work)
+        # the request id in scope (handle() opened it) rides into the task so
+        # the pool thread's traces correlate; a deduped resubmission keeps the
+        # first request's id — the task is one operation, whoever polls it
+        task = self.user_tasks.get_or_create(
+            endpoint, key, work, parent_id=obs.current_parent_id()
+        )
         task.result_to_json = to_json   # USER_TASKS serves the final body
         headers = {"User-Task-ID": task.task_id}
         if task.status in (TaskStatus.COMPLETED, TaskStatus.COMPLETED_WITH_ERROR):
@@ -545,7 +581,14 @@ class CruiseControlApp:
 
     def handle(
         self, method: str, endpoint: str, params: Dict[str, List[str]], headers
-    ) -> Tuple[int, dict, Dict[str, str]]:
+    ) -> Tuple[int, Union[dict, str], Dict[str, str]]:
+        """Authenticate, authorize, dispatch.  Every request runs inside a
+        correlation scope: the inbound ``X-Request-Id`` (or a generated one)
+        becomes the ``parent_id`` of every flight-recorder trace the request
+        causes — synchronously in this thread, or via the user-task pool and
+        the executor thread — and is echoed back as a response header."""
+        from cruise_control_tpu.obs import recorder as obs
+
         try:
             user, role = self.security.authenticate(headers)
         except AuthenticationError as e:
@@ -554,6 +597,18 @@ class CruiseControlApp:
         if not self.security.authorize(role, endpoint, method):
             return 403, {"error": f"role {role.name} may not {method} {endpoint}"}, {}
 
+        request_id = headers.get("X-Request-Id") or f"req-{uuid.uuid4().hex[:16]}"
+        with obs.parent_scope(request_id):
+            status, body, out_headers = self._dispatch_authorized(
+                method, endpoint, params, user, role
+            )
+        out_headers = dict(out_headers)
+        out_headers.setdefault("X-Request-Id", request_id)
+        return status, body, out_headers
+
+    def _dispatch_authorized(
+        self, method: str, endpoint: str, params: Dict[str, List[str]], user, role
+    ) -> Tuple[int, Union[dict, str], Dict[str, str]]:
         try:
             if method == "GET":
                 if endpoint == "PERMISSIONS":
@@ -611,10 +666,18 @@ class _Handler(BaseHTTPRequestHandler):
         status, body, headers = self.app.handle(method, endpoint, params, self.headers)
         self._respond(status, body, headers)
 
-    def _respond(self, status: int, body: dict, headers: Dict[str, str]) -> None:
-        payload = json.dumps(body, default=str).encode()
+    def _respond(
+        self, status: int, body: Union[dict, str], headers: Dict[str, str]
+    ) -> None:
+        if isinstance(body, str):
+            # plain-text endpoints (METRICS): Prometheus exposition format
+            payload = body.encode()
+            content_type = "text/plain; version=0.0.4; charset=utf-8"
+        else:
+            payload = json.dumps(body, default=str).encode()
+            content_type = "application/json"
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
         for k, v in headers.items():
             self.send_header(k, v)

@@ -41,6 +41,12 @@ class UserTask:
     #: completed task's final body, so clients never have to re-issue the
     #: original (possibly mutating) request just to read the result
     result_to_json: Optional[Callable[[object], dict]] = None
+    #: correlation id of the REST request that created the task (inbound
+    #: ``X-Request-Id`` or server-generated); every flight-recorder trace the
+    #: task's work emits inherits it as ``parent_id``, so GET /TRACES walks
+    #: request → user task → optimize → execution on one id.  A deduped
+    #: re-submission keeps the FIRST request's id (the task is one operation).
+    parent_id: Optional[str] = None
 
     def to_dict(self) -> dict:
         d = {
@@ -50,6 +56,8 @@ class UserTask:
             "StartMs": self.created_ms,
             "Progress": self.progress.to_list(),
         }
+        if self.parent_id is not None:
+            d["RequestId"] = self.parent_id
         if self.status is TaskStatus.COMPLETED and self.result_to_json is not None:
             try:
                 d["result"] = self.result_to_json(self.future.result(timeout=0))
@@ -77,9 +85,13 @@ class UserTaskManager:
         endpoint: str,
         request_key: Tuple,
         work: Callable[[OperationProgress], object],
+        parent_id: Optional[str] = None,
     ) -> UserTask:
         """Dedupe by request key: re-submitting the same request returns the same
-        task (getOrCreateUserTask:222's session semantics, keyed by parameters)."""
+        task (getOrCreateUserTask:222's session semantics, keyed by parameters).
+        ``parent_id`` is the request's correlation id — the worker thread runs
+        inside its trace scope and emits a ``user_task`` flight record, so the
+        id links the task to every optimize/execution trace it caused."""
         with self._lock:
             self._expire_locked()
             existing_id = self._by_key.get(request_key)
@@ -100,21 +112,36 @@ class UserTaskManager:
                 progress=progress,
                 future=None,  # type: ignore[arg-type]
                 created_ms=int(time.time() * 1000),
+                parent_id=parent_id,
             )
             self._tasks[task_id] = task
             self._by_key[request_key] = task_id
 
         def _run():
+            from cruise_control_tpu.obs import recorder as obs
+
             task.status = TaskStatus.IN_EXECUTION
-            try:
-                result = work(progress)
-                task.status = TaskStatus.COMPLETED
-                return result
-            except Exception:
-                task.status = TaskStatus.COMPLETED_WITH_ERROR
-                raise
-            finally:
-                progress.complete()
+            # the pool thread has no ambient scope — re-open the request's
+            # here so the work's optimize/execution traces correlate
+            with obs.parent_scope(task.parent_id):
+                token = obs.start_trace("user_task")
+                try:
+                    result = work(progress)
+                    task.status = TaskStatus.COMPLETED
+                    return result
+                except Exception:
+                    task.status = TaskStatus.COMPLETED_WITH_ERROR
+                    raise
+                finally:
+                    progress.complete()
+                    obs.finish_trace(
+                        token,
+                        attrs={
+                            "endpoint": endpoint,
+                            "task_id": task_id,
+                            "status": task.status.value,
+                        },
+                    )
 
         task.future = self._pool.submit(_run)
         return task

@@ -12,6 +12,7 @@ harness equivalent), with real backends pluggable via ``cluster.backend.class``.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Mapping, Optional
@@ -138,6 +139,14 @@ class CruiseControlTpuApp:
         self.compile_cache_dir = configure_compile_cache(
             cfg.get("compile.cache.dir") or None
         )
+
+        # device/executable profiler (obs/profiler.py): config wins unless the
+        # CC_TPU_PROFILER env override is present (ops kill-switch semantics,
+        # same precedence as the compile cache above)
+        from cruise_control_tpu.obs.profiler import PROFILER
+
+        if os.environ.get("CC_TPU_PROFILER") is None:
+            PROFILER.enabled = bool(cfg.get("profiler.enable"))
 
         self._demo_backend = False
         if backend is None:

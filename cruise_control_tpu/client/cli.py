@@ -32,6 +32,21 @@ def build_parser() -> argparse.ArgumentParser:
                  "review_board", "permissions", "bootstrap", "train"):
         sub.add_parser(name)
 
+    sub.add_parser(
+        "metrics",
+        help="print the Prometheus text exposition page (GET /metrics)",
+    )
+
+    tr = sub.add_parser(
+        "traces", help="flight-recorder records, filterable by correlation id"
+    )
+    tr.add_argument("--kind", default=None,
+                    help="optimize | execution | user_task | simulate | ...")
+    tr.add_argument("--trace-id", default=None)
+    tr.add_argument("--parent-id", default=None,
+                    help="X-Request-Id: walks request -> task -> optimize -> execution")
+    tr.add_argument("--limit", type=int, default=50)
+
     pl = sub.add_parser("partition_load")
     pl.add_argument("--resource", default="DISK")
     pl.add_argument("--entries", type=int, default=20)
@@ -43,6 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
         if name == "rebalance":
             p.add_argument("--goals", default=None, help="comma-separated goal names")
             p.add_argument("--excluded-topics", default=None)
+            p.add_argument("--request-id", default=None,
+                           help="X-Request-Id to correlate the operation's traces")
         if name == "rightsize":
             p.add_argument("--load-factor", type=float, default=None,
                            help="plan capacity for current load × this factor")
@@ -105,12 +122,20 @@ def main(argv=None) -> int:
         if ep in ("state", "load", "proposals", "kafka_cluster_state", "user_tasks",
                   "review_board", "permissions", "bootstrap", "train"):
             out = getattr(client, ep)()
+        elif ep == "metrics":
+            # exposition format IS the output format — no JSON re-wrap
+            print(client.metrics(), end="")
+            return 0
+        elif ep == "traces":
+            out = client.traces(kind=args.kind, trace_id=args.trace_id,
+                                parent_id=args.parent_id, limit=args.limit)
         elif ep == "partition_load":
             out = client.partition_load(resource=args.resource, entries=args.entries)
         elif ep == "rebalance":
             goals = args.goals.split(",") if args.goals else None
             out = client.rebalance(dryrun=args.dryrun, goals=goals,
-                                   excluded_topics=args.excluded_topics, wait=wait)
+                                   excluded_topics=args.excluded_topics, wait=wait,
+                                   request_id=args.request_id)
         elif ep in ("add_broker", "remove_broker", "demote_broker"):
             out = getattr(client, ep)(_int_list(args.brokers), dryrun=args.dryrun, wait=wait)
         elif ep == "fix_offline_replicas":

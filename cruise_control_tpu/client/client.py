@@ -49,7 +49,12 @@ class CruiseControlClient:
     # -- transport -----------------------------------------------------------
 
     def _request(
-        self, method: str, endpoint: str, params: Optional[Dict[str, Any]] = None
+        self,
+        method: str,
+        endpoint: str,
+        params: Optional[Dict[str, Any]] = None,
+        headers: Optional[Dict[str, str]] = None,
+        raw: bool = False,
     ) -> Tuple[int, Any, Dict[str, str]]:
         qs = urllib.parse.urlencode(
             {k: v for k, v in (params or {}).items() if v is not None}
@@ -60,16 +65,23 @@ class CruiseControlClient:
         req = urllib.request.Request(url, method=method, data=b"" if method == "POST" else None)
         if self._auth:
             req.add_header("Authorization", self._auth)
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
         try:
             with urllib.request.urlopen(req) as resp:
-                body = json.loads(resp.read() or b"{}")
+                payload = resp.read()
+                body = (
+                    payload.decode()
+                    if raw
+                    else json.loads(payload or b"{}")
+                )
                 return resp.status, body, dict(resp.headers)
         except urllib.error.HTTPError as e:
-            raw = e.read()
+            data = e.read()
             try:
-                body = json.loads(raw) if raw else {}
+                body = json.loads(data) if data else {}
             except json.JSONDecodeError:
-                body = {"raw": raw.decode(errors="replace")}
+                body = {"raw": data.decode(errors="replace")}
             if e.code >= 400:
                 raise ClientError(e.code, body) from None
             return e.code, body, dict(e.headers)
@@ -80,8 +92,17 @@ class CruiseControlClient:
             raise ClientError(status, body)
         return body
 
-    def _post(self, endpoint: str, wait: bool = True, **params) -> Any:
-        status, body, headers = self._request("POST", endpoint, params)
+    def _post(
+        self,
+        endpoint: str,
+        wait: bool = True,
+        request_id: Optional[str] = None,
+        **params,
+    ) -> Any:
+        headers = {"X-Request-Id": request_id} if request_id else None
+        status, body, headers = self._request(
+            "POST", endpoint, params, headers=headers
+        )
         if status >= 400:
             raise ClientError(status, body)
         if status == 202:
@@ -147,6 +168,27 @@ class CruiseControlClient:
     def train(self, start: Optional[int] = None, end: Optional[int] = None) -> Any:
         return self._get("train", start=start, end=end)
 
+    def traces(
+        self,
+        kind: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        parent_id: Optional[str] = None,
+        limit: int = 50,
+    ) -> Any:
+        """GET /traces: flight-recorder records; ``parent_id`` walks one
+        ``X-Request-Id`` through request → user task → optimize → execution."""
+        return self._get(
+            "traces", kind=kind, trace_id=trace_id, parent_id=parent_id,
+            limit=limit,
+        )
+
+    def metrics(self) -> str:
+        """GET /metrics: the Prometheus text exposition page, verbatim."""
+        status, body, _ = self._request("GET", "metrics", raw=True)
+        if status >= 400:
+            raise ClientError(status, body)
+        return body
+
     # -- POST endpoints (:27-39) ---------------------------------------------
 
     @staticmethod
@@ -162,9 +204,14 @@ class CruiseControlClient:
         goals: Optional[Sequence[str]] = None,
         excluded_topics: Optional[str] = None,
         wait: bool = True,
+        request_id: Optional[str] = None,
     ) -> Any:
+        """``request_id`` rides the ``X-Request-Id`` header: every trace the
+        rebalance causes (user task, optimize, execution) carries it as
+        ``parent_id`` — retrieve the whole story with :meth:`traces`."""
         return self._post(
-            "rebalance", wait=wait, dryrun=str(dryrun).lower(),
+            "rebalance", wait=wait, request_id=request_id,
+            dryrun=str(dryrun).lower(),
             goals=self._csv(goals), excluded_topics=excluded_topics,
         )
 

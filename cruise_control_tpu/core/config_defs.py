@@ -133,6 +133,11 @@ def analyzer_config() -> ConfigDef:
              "deserialize the solver's compiled programs instead of paying "
              "the ~30-program cold compile (TPU-specific; empty = env "
              "CC_TPU_COMPILE_CACHE, unset = no persistent cache).")
+    d.define("profiler.enable", Type.BOOLEAN, True, L,
+             "Device/executable profiler (obs/profiler.py): per-compiled-"
+             "program FLOPs/bytes/call counts in STATE, /METRICS and trace "
+             "cost attrs.  Host-side only — warm paths gain zero dispatches "
+             "and zero compiles either way (env override CC_TPU_PROFILER=0).")
     return d
 
 

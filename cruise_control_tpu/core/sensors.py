@@ -201,3 +201,5 @@ SIM_BUCKET_HITS_COUNTER = "ScenarioPlanner.bucket-hits"
 SIM_BUCKET_MISSES_COUNTER = "ScenarioPlanner.bucket-misses"
 SIM_SWEEP_TIMER = "ScenarioPlanner.sweep-timer"
 PLANNER_FAILURES_COUNTER = "GoalViolationDetector.planner-failures"
+EXPORTER_RENDER_TIMER = "MetricsExporter.render-timer"
+METRICS_SCRAPES_COUNTER = "MetricsExporter.scrapes"

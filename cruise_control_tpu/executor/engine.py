@@ -216,6 +216,12 @@ class Executor:
     ) -> ExecutionSummary:
         """Run the 3-phase execution; rejects when one is ongoing
         (Executor.java:810 synchronized semantics)."""
+        from cruise_control_tpu.obs import recorder as obs
+
+        # capture the submitter's request id NOW: the execution runs in its
+        # own thread, which has no ambient trace scope — _run_execution
+        # re-opens the scope so the execution trace correlates to the request
+        parent_id = obs.current_parent_id()
         with self._lock:
             if self.has_ongoing_execution:
                 raise OngoingExecutionError("an execution is already in progress")
@@ -226,7 +232,9 @@ class Executor:
             self._planner = planner
             execution_id = next(self._execution_ids)
             self._execution_thread = threading.Thread(
-                target=self._run_execution, args=(execution_id, planner), daemon=True
+                target=self._run_execution,
+                args=(execution_id, planner, parent_id),
+                daemon=True,
             )
             self._execution_thread.start()
         if wait:
@@ -260,7 +268,12 @@ class Executor:
 
     # -- execution phases ----------------------------------------------------
 
-    def _run_execution(self, execution_id: int, planner: ExecutionTaskPlanner) -> None:
+    def _run_execution(
+        self,
+        execution_id: int,
+        planner: ExecutionTaskPlanner,
+        parent_id: Optional[str] = None,
+    ) -> None:
         from cruise_control_tpu.core.sensors import (
             EXECUTION_FAILED_COUNTER,
             EXECUTION_STARTED_COUNTER,
@@ -269,7 +282,7 @@ class Executor:
         )
         from cruise_control_tpu.obs import recorder as obs
 
-        trace_token = obs.start_trace("execution")
+        trace_token = obs.start_trace("execution", parent_id=parent_id)
         phase_spans = []
         t0 = time.monotonic()
         REGISTRY.counter(EXECUTION_STARTED_COUNTER).inc()

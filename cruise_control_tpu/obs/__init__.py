@@ -15,6 +15,14 @@ arXiv:1705.01662) and measured-speedup discipline (*CvxCluster*):
   (``BENCH_r*.json``, ``benchmarks/GATE_BASELINE_cpu.json``), runs a fast
   bench tier under a hard timeout, and exits nonzero on wall-clock/dispatch/
   violation/balancedness regressions (``scripts/bench_gate.py``).
+- :mod:`cruise_control_tpu.obs.exporter` — renders the whole telemetry plane
+  (sensor registry, flight-recorder summary, gate baseline, executable
+  profiler) in Prometheus text exposition format for ``GET /METRICS``, with
+  the strict parser CI lints the page against.
+- :mod:`cruise_control_tpu.obs.profiler` — per-compiled-executable cost
+  registry (HLO FLOPs/bytes, call counts, attributed compiles) + per-device
+  memory gauges sampled at trace boundaries; pure host-side, zero added
+  dispatches on warm paths.
 """
 
 from cruise_control_tpu.obs.recorder import (  # noqa: F401
@@ -22,5 +30,8 @@ from cruise_control_tpu.obs.recorder import (  # noqa: F401
     FlightRecorder,
     Span,
     TraceRecord,
+    current_parent_id,
+    parent_scope,
     read_jsonl,
 )
+from cruise_control_tpu.obs.profiler import PROFILER, profile_jit  # noqa: F401

@@ -74,6 +74,9 @@ class GateTier:
     bench_comparable: bool = True
     #: needs --xla_force_host_platform_device_count=8 in the child process
     needs_devices: int = 0
+    #: non-solver tiers (exporter render wall): a self-contained measurement
+    #: function replacing the build/optimize flow entirely
+    runner: Optional[Callable[[float], dict]] = None
 
 
 # -- tier builders ------------------------------------------------------------------
@@ -164,6 +167,77 @@ def _build_smoke():
     return opt, state, ctx
 
 
+def _run_exporter_tier(inject_sleep_s: float = 0.0) -> dict:
+    """Render wall of /METRICS over a FULLY-populated telemetry plane.
+
+    The scrape path must stay cheap — Prometheus hits it every few seconds,
+    and a rendering slowdown is invisible to the solver benches.  This tier
+    builds a worst-case realistic registry (every Sensors.md family populated,
+    full timer rings, a full flight-recorder ring, dozens of profiled
+    executables), measures the best-of-N render, and round-trips the output
+    through the strict exposition parser — an unparseable page fails the gate
+    outright, not just a slow one."""
+    from cruise_control_tpu.core.sensors import SensorRegistry
+    from cruise_control_tpu.obs.exporter import parse_exposition, render_prometheus
+    from cruise_control_tpu.obs.profiler import DeviceProfiler
+    from cruise_control_tpu.obs.recorder import FlightRecorder, Span, TraceRecord
+
+    registry = SensorRegistry()
+    families = ("GoalOptimizer", "LoadMonitor", "Executor", "AnomalyDetector",
+                "ScenarioPlanner", "RetryPolicy", "FlightRecorder", "ChaosBackend")
+    for fam in families:
+        for i in range(8):
+            t = registry.timer(f"{fam}.timer-{i}")
+            for k in range(256):          # full percentile ring
+                t.update(0.001 * ((k * 37) % 101))
+            registry.gauge(f"{fam}.gauge-{i}").set(i * 1.5)
+            registry.counter(f"{fam}.counter-{i}").inc(i * 1000 + 1)
+        registry.meter(f"{fam}.meter").mark(32)
+
+    recorder = FlightRecorder(capacity=256)
+    for i in range(256):
+        recorder.record(TraceRecord(
+            kind=("optimize", "execution", "detector", "simulate")[i % 4],
+            trace_id=f"t-{i}", started_at=0.0, duration_s=0.1, platform="cpu",
+            spans=[Span("s", "goal", 0.1, 1)],
+        ))
+
+    profiler = DeviceProfiler()
+    for i in range(24):
+        entry, _ = profiler.on_call(
+            f"optimizer.program_{i % 6}", ("k", i), f"sig-{i}", 0.01, []
+        )
+        profiler.set_analysis(
+            ("k", i), {"flops": 1e9 + i, "bytes accessed": 2e9 + i}
+        )
+
+    # a single render is ~ms — far below the gate's absolute noise floor — so
+    # the gated wall is a 500-render batch (best of 2): scrape-rate work where
+    # the 25 % ratio threshold actually binds
+    renders = 500
+    best = float("inf")
+    text = ""
+    for _ in range(2):
+        t0 = time.monotonic()
+        for _i in range(renders):
+            text = render_prometheus(
+                registry=registry, recorder=recorder, profiler=profiler
+            )
+        best = min(best, time.monotonic() - t0)
+    parsed = parse_exposition(text)        # malformed page ⇒ gate failure
+    if inject_sleep_s:
+        time.sleep(inject_sleep_s)
+        best += inject_sleep_s
+    return {
+        "tier": "exporter",
+        "platform": "cpu",
+        "wall_s": round(best, 4),
+        "renders": renders,
+        "series": sum(len(m["samples"]) for m in parsed.values()),
+        "metric_families": len(parsed),
+    }
+
+
 TIERS: Dict[str, GateTier] = {
     t.name: t
     for t in (
@@ -176,9 +250,12 @@ TIERS: Dict[str, GateTier] = {
                  needs_devices=8),
         GateTier("smoke", "test-only: 4 brokers / 24 partitions, 4 goals",
                  _build_smoke, bench_comparable=False),
+        GateTier("exporter", "/METRICS render wall, fully-populated registry",
+                 build=None, bench_comparable=False,
+                 runner=_run_exporter_tier),
     )
 }
-DEFAULT_TIERS = ("config1", "config2_small", "mesh8")
+DEFAULT_TIERS = ("config1", "config2_small", "mesh8", "exporter")
 
 
 # -- measurement --------------------------------------------------------------------
@@ -202,6 +279,9 @@ def run_tier(name: str, inject_sleep_s: float = 0.0) -> dict:
     hook for simulating a wall-clock regression without touching the solver.
     """
     tier = TIERS[name]
+    if tier.runner is not None:
+        # self-contained measurement (exporter render wall) — no solver run
+        return tier.runner(inject_sleep_s)
     _force_cpu_platform()
     import jax
 
@@ -510,10 +590,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             m = run_tier_subprocess(name, args.timeout, args.inject_sleep)
         m.setdefault("gate_wall_s", round(time.monotonic() - t0, 1))
         measurements.append(m)
-        status = m.get("error") or (
-            f"wall={m['wall_s']}s dispatches={m['num_dispatches']} "
-            f"hard={m['residual_hard_violations']} bal={m['balancedness']}"
-        )
+        if m.get("error"):
+            status = m["error"]
+        elif "num_dispatches" in m:
+            status = (
+                f"wall={m['wall_s']}s dispatches={m['num_dispatches']} "
+                f"hard={m['residual_hard_violations']} bal={m['balancedness']}"
+            )
+        else:   # runner tiers (exporter) gate wall only
+            status = f"wall={m['wall_s']}s series={m.get('series')}"
         print(f"bench_gate: [{name}] {status}", flush=True)
 
     errors = [m for m in measurements if "error" in m]

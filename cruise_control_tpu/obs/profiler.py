@@ -1,0 +1,372 @@
+"""Device/executable profiler: what every compiled program costs, host-side.
+
+CvxCluster (arXiv 2605.01614) reports solver cost in per-program FLOPs/bytes
+terms; the reference's JMX surface has nothing device-shaped at all.  This
+module closes the gap with a process-wide registry of every compiled
+executable the solver dispatches:
+
+* **Registration** — the optimizer/sim jit sites wrap their module-level
+  jitted callables in :func:`profile_jit`.  The wrapper is pure host-side
+  bookkeeping: it counts calls, attributes XLA compile events (via the
+  recorder's existing ``jax.monitoring`` listener marks), and — once per
+  (program, input-shape) signature — derives FLOPs / bytes-accessed from
+  ``Lowered.cost_analysis()``.  Cost analysis runs on the *unoptimized* HLO
+  of a fresh lowering (tracing only — never a second XLA compile, never a
+  device dispatch), so a warm path through a profiled program costs a dict
+  lookup and two counter increments; the regression gate's warm-recompile
+  and dispatch-budget checks hold with the profiler enabled.
+* **Memory** — :meth:`DeviceProfiler.sample_memory` reads
+  ``device.memory_stats()`` (peak/in-use per device) at flight-recorder trace
+  boundaries.  CPU backends report ``None`` and pure-numpy environments have
+  no devices at all; both degrade to an empty sample, never an error.
+* **Attribution** — :meth:`DeviceProfiler.mark` / :meth:`cost_since` window
+  the per-call log the way ``compile_mark`` windows the compile log, so an
+  ``optimize()`` trace can carry exactly the FLOPs/bytes its own dispatches
+  executed (the ``attrs["cost"]`` block).
+
+``CC_TPU_PROFILER=0`` disables the whole layer (wrappers become transparent
+pass-throughs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from cruise_control_tpu.obs.recorder import compile_events_since, compile_mark
+
+#: per-call log cap (the mark/cost_since window source); ~50 calls per
+#: optimize means hundreds of optimizes stay addressable
+_CALL_LOG_CAP = 8192
+
+
+@dataclasses.dataclass
+class ExecutableProfile:
+    """One compiled program signature: a (wrapped jit, input shapes) pair."""
+
+    program: str                      # registration name, e.g. "optimizer.goal_step"
+    signature: str                    # human-readable input-shape summary
+    calls: int = 0
+    total_call_s: float = 0.0         # enqueue wall, not device time
+    last_call_s: float = 0.0
+    compile_events: int = 0           # XLA compiles attributed to this program
+    compile_s: float = 0.0
+    #: HLO cost analysis of the lowered module; None until analyzed, and
+    #: permanently None where the jax build cannot analyze (degraded mode)
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    analysis: str = "pending"         # "pending" | "ok" | "unavailable"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _leaf_sig(x) -> tuple:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (tuple(shape), str(dtype))
+    return (type(x).__name__,)
+
+
+def _static_key(v):
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+class DeviceProfiler:
+    """Process-wide executable/memory registry (the device-side Sensors.md)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[tuple, ExecutableProfile] = {}
+        self._call_log: List[tuple] = []   # (entry key) per profiled call
+        self._call_base = 0                # calls trimmed off the log front
+        self._memory: List[dict] = []
+        self._enabled: Optional[bool] = None
+
+    @property
+    def enabled(self) -> bool:
+        if self._enabled is None:
+            env = os.environ.get("CC_TPU_PROFILER")
+            self._enabled = env not in ("0", "false", "") if env is not None else True
+        return self._enabled
+
+    @enabled.setter
+    def enabled(self, value: bool) -> None:
+        self._enabled = bool(value)
+
+    # -- per-call bookkeeping (called by the profile_jit wrapper) ------------
+
+    def on_call(
+        self,
+        program: str,
+        key: tuple,
+        signature: str,
+        wall_s: float,
+        events: List[dict],
+    ) -> Tuple[ExecutableProfile, bool]:
+        """Record one call; returns (entry, first_sight_of_signature)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            fresh = entry is None
+            if fresh:
+                entry = ExecutableProfile(program=program, signature=signature)
+                self._entries[key] = entry
+            entry.calls += 1
+            entry.total_call_s += wall_s
+            entry.last_call_s = wall_s
+            entry.compile_events += len(events)
+            entry.compile_s += sum(e.get("duration_s", 0.0) for e in events)
+            self._call_log.append(key)
+            drop = len(self._call_log) - _CALL_LOG_CAP
+            if drop > 0:
+                del self._call_log[:drop]
+                self._call_base += drop
+        return entry, fresh
+
+    def set_analysis(self, key: tuple, cost: Optional[dict]) -> None:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return
+            if cost is None:
+                entry.analysis = "unavailable"
+                return
+            entry.flops = float(cost.get("flops", 0.0) or 0.0)
+            entry.bytes_accessed = float(cost.get("bytes accessed", 0.0) or 0.0)
+            entry.analysis = "ok"
+
+    # -- windows (the attrs["cost"] block) -----------------------------------
+
+    def mark(self) -> int:
+        """Absolute profiled-call count; pair with :meth:`cost_since`.
+
+        The call log is process-global, so concurrent operations' windows
+        overlap and cross-attribute — the same documented tradeoff as the
+        recorder's compile-event marks: acceptable for a diagnostic record,
+        single-threaded emitters are exact."""
+        with self._lock:
+            return self._call_base + len(self._call_log)
+
+    def cost_since(self, mark: int) -> dict:
+        """Aggregate cost of the profiled calls made since ``mark``:
+        executed FLOPs / bytes (per-call analysis × call count), the
+        program tally, and a device-memory watermark sampled NOW (so the
+        closing trace reports the memory its own dispatches reached, not
+        the previous boundary's sample)."""
+        with self._lock:
+            window = list(self._call_log[max(mark - self._call_base, 0):])
+            entries = dict(self._entries)
+        flops = 0.0
+        bytes_accessed = 0.0
+        unanalyzed = 0
+        for key in window:
+            entry = entries.get(key)
+            if entry is None or entry.flops is None:
+                unanalyzed += 1
+                continue
+            flops += entry.flops
+            bytes_accessed += entry.bytes_accessed or 0.0
+        memory = self.sample_memory()
+        peaks = [
+            m["peak_bytes_in_use"] for m in memory
+            if m.get("peak_bytes_in_use") is not None
+        ]
+        return {
+            "flops": flops,
+            "bytes_accessed": bytes_accessed,
+            "profiled_calls": len(window),
+            "unanalyzed_calls": unanalyzed,
+            "memory_peak_bytes": max(peaks) if peaks else None,
+        }
+
+    # -- memory (sampled at trace boundaries by recorder.finish_trace) -------
+
+    def sample_memory(self) -> List[dict]:
+        """Refresh per-device memory gauges from ``device.memory_stats()``.
+
+        Degrades in layers: profiler disabled (CC_TPU_PROFILER=0 /
+        profiler.enable=false — the whole layer means the whole layer, memory
+        gauges included) → empty; no jax → empty; CPU backends whose
+        ``memory_stats()`` is None → device rows with null byte counts (the
+        exporter skips null-valued gauges)."""
+        from cruise_control_tpu.core.sensors import REGISTRY
+
+        if not self.enabled:
+            return []
+        samples: List[dict] = []
+        try:
+            import jax
+
+            for i, d in enumerate(jax.local_devices()):
+                stats = {}
+                try:
+                    stats = d.memory_stats() or {}
+                except Exception:
+                    pass
+                row = {
+                    "device": f"{d.platform}:{i}",
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit"),
+                }
+                samples.append(row)
+                for stat in ("bytes_in_use", "peak_bytes_in_use"):
+                    if row[stat] is not None:
+                        REGISTRY.gauge(
+                            f"DeviceMemory.{row['device']}-{stat.replace('_', '-')}"
+                        ).set(row[stat])
+        except Exception:
+            samples = []
+        with self._lock:
+            self._memory = samples
+        return samples
+
+    # -- export surfaces -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """STATE / METRICS surface: every executable + the last memory sample."""
+        with self._lock:
+            executables = [e.to_dict() for e in self._entries.values()]
+            memory = list(self._memory)
+        executables.sort(key=lambda e: (e["program"], e["signature"]))
+        return {
+            "enabled": self.enabled,
+            "executables": executables,
+            "memory": memory,
+        }
+
+    def per_program_totals(self) -> Dict[str, dict]:
+        """Aggregate over shape signatures: the exporter's per-program rows.
+        ``flops_total``/``bytes_total`` are *executed* totals (analysis ×
+        calls), the CvxCluster-style cumulative cost of each program."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            row = out.setdefault(
+                e.program,
+                {
+                    "calls": 0, "call_seconds": 0.0, "compile_events": 0,
+                    "compile_seconds": 0.0, "flops_total": 0.0,
+                    "bytes_total": 0.0, "signatures": 0,
+                },
+            )
+            row["calls"] += e.calls
+            row["call_seconds"] += e.total_call_s
+            row["compile_events"] += e.compile_events
+            row["compile_seconds"] += e.compile_s
+            row["signatures"] += 1
+            if e.flops is not None:
+                row["flops_total"] += e.flops * e.calls
+                row["bytes_total"] += (e.bytes_accessed or 0.0) * e.calls
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._call_log.clear()
+            self._call_base = 0
+            self._memory = []
+
+
+#: process-wide profiler (the device-cost counterpart of sensors.REGISTRY)
+PROFILER = DeviceProfiler()
+
+
+class ProfiledJit:
+    """Transparent wrapper around a jitted callable that feeds PROFILER.
+
+    The wrapped call itself is untouched — same args, same outputs, same jit
+    cache, zero added dispatches.  On the first call of a new input-shape
+    signature (the cold path, where XLA compilation already dominates) the
+    wrapper additionally lowers the function once more from shape structs to
+    run HLO cost analysis; warm calls never re-trace."""
+
+    def __init__(self, name: str, fn) -> None:
+        self._name = name
+        self._fn = fn
+
+    @property
+    def __wrapped__(self):
+        return self._fn
+
+    def lower(self, *args, **kwargs):
+        return self._fn.lower(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        if not PROFILER.enabled:
+            return self._fn(*args, **kwargs)
+        try:
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(args)
+            key = (
+                self._name,
+                tuple(_leaf_sig(x) for x in leaves),
+                tuple(sorted((k, _static_key(v)) for k, v in kwargs.items())),
+            )
+        except Exception:
+            return self._fn(*args, **kwargs)
+        mark = compile_mark()
+        t0 = time.monotonic()
+        out = self._fn(*args, **kwargs)
+        wall = time.monotonic() - t0
+        try:
+            _, fresh = PROFILER.on_call(
+                self._name, key, self._signature(leaves), wall,
+                compile_events_since(mark),
+            )
+            if fresh:
+                PROFILER.set_analysis(key, self._analyze(args, kwargs))
+        except Exception:
+            pass   # observability must not break the dispatch it observes
+        return out
+
+    @staticmethod
+    def _signature(leaves) -> str:
+        arrays = [s for s in (_leaf_sig(x) for x in leaves) if len(s) == 2]
+        if not arrays:
+            return "scalar"
+        # the largest leaf names the signature; the tally disambiguates
+        big = max(arrays, key=lambda s: _size(s[0]))
+        return f"{len(arrays)} leaves, max {list(big[0])}:{big[1]}"
+
+    def _analyze(self, args, kwargs) -> Optional[dict]:
+        """FLOPs/bytes of the lowered (unoptimized) module — tracing only,
+        no XLA compile, no dispatch.  Donated input buffers may already be
+        consumed, so lowering goes through shape structs, never values."""
+        try:
+            import jax
+
+            sds_args = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+                if hasattr(x, "shape") and hasattr(x, "dtype")
+                else x,
+                args,
+            )
+            cost = self._fn.lower(*sds_args, **kwargs).cost_analysis()
+            if isinstance(cost, (list, tuple)):   # per-device list on old jax
+                cost = cost[0] if cost else None
+            return dict(cost) if cost else None
+        except Exception:
+            return None
+
+
+def _size(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def profile_jit(name: str, fn) -> ProfiledJit:
+    """Register a module-level jitted callable with the executable profiler."""
+    return ProfiledJit(name, fn)

@@ -24,6 +24,8 @@ asserted by ``tests/test_obs.py`` and checked by the regression gate.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 import itertools
 import json
@@ -74,6 +76,11 @@ class TraceRecord:
     #: ([{"event": name, "duration_s": secs}]); empty when jax.monitoring
     #: listeners are unavailable
     compile_events: List[dict] = dataclasses.field(default_factory=list)
+    #: correlation id linking this trace to the request/task that caused it —
+    #: the inbound ``X-Request-Id`` (or the server-generated one) threaded
+    #: through the user-task machinery; None for autonomous traces (detector
+    #: cycles, background refreshes)
+    parent_id: Optional[str] = None
     schema: int = SCHEMA_VERSION
 
     @property
@@ -100,8 +107,39 @@ class TraceRecord:
             attrs=dict(d.get("attrs", {})),
             spans=[Span.from_dict(s) for s in d.get("spans", [])],
             compile_events=list(d.get("compile_events", [])),
+            parent_id=d.get("parent_id"),
             schema=d.get("schema", SCHEMA_VERSION),
         )
+
+
+# -- request-id propagation ---------------------------------------------------------
+#
+# The REST layer stamps every request with an id (inbound ``X-Request-Id`` or
+# generated) and opens a :func:`parent_scope` around the work it triggers; any
+# ``start_trace`` inside the scope inherits the id as ``parent_id``, so one id
+# walks request → user task → optimize → execution in GET /TRACES.  A
+# contextvar (not a thread-local): scopes are explicit tokens, and subsystems
+# that hop threads (user-task pool, executor thread) re-open the scope in the
+# worker with the id they captured at submission.
+
+_PARENT_ID: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "cc_tpu_trace_parent_id", default=None
+)
+
+
+def current_parent_id() -> Optional[str]:
+    """The request id in scope, if any (what new traces will inherit)."""
+    return _PARENT_ID.get()
+
+
+@contextlib.contextmanager
+def parent_scope(parent_id: Optional[str]):
+    """Attach ``parent_id`` to every trace started inside the with-block."""
+    token = _PARENT_ID.set(parent_id)
+    try:
+        yield
+    finally:
+        _PARENT_ID.reset(token)
 
 
 # -- JAX compile-event capture ------------------------------------------------------
@@ -197,9 +235,13 @@ class FlightRecorder:
 
         with self._lock:
             self._ring.append(trace)
-            if len(self._ring) > self.capacity:
-                del self._ring[: len(self._ring) - self.capacity]
-                self._dropped += 1
+            trimmed = len(self._ring) - self.capacity
+            if trimmed > 0:
+                # a shrunk capacity (or bulk insertion) trims several records
+                # at once — the drop counter must account for every one of
+                # them, not just the trim event
+                del self._ring[:trimmed]
+                self._dropped += trimmed
             path = self.jsonl_path
             size = len(self._ring)
         if path:
@@ -218,13 +260,23 @@ class FlightRecorder:
         return trace
 
     def recent(
-        self, limit: int = 50, kind: Optional[str] = None
+        self,
+        limit: int = 50,
+        kind: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        parent_id: Optional[str] = None,
     ) -> List[TraceRecord]:
-        """Newest-first slice of the ring, optionally filtered by kind."""
+        """Newest-first slice of the ring, optionally filtered by kind,
+        exact trace id, or correlation ``parent_id`` (one request id walks
+        request → user task → optimize → execution)."""
         with self._lock:
             items = list(reversed(self._ring))
         if kind is not None:
             items = [t for t in items if t.kind == kind]
+        if trace_id is not None:
+            items = [t for t in items if t.trace_id == trace_id]
+        if parent_id is not None:
+            items = [t for t in items if t.parent_id == parent_id]
         return items[: max(limit, 0)]
 
     def clear(self) -> None:
@@ -247,14 +299,39 @@ class FlightRecorder:
             }
 
 
-def read_jsonl(path: str) -> List[TraceRecord]:
-    """Load an append-only sink back into records (blank lines skipped)."""
-    out: List[TraceRecord] = []
+class JsonlRecords(List[TraceRecord]):
+    """``read_jsonl``'s result: a plain record list plus the count of trailing
+    lines skipped as corrupt/partial (0 for a clean sink)."""
+
+    skipped: int = 0
+
+
+def read_jsonl(path: str) -> JsonlRecords:
+    """Load an append-only sink back into records (blank lines skipped),
+    streaming — a long-lived server's sink can be large.
+
+    A crash mid-append leaves a truncated (or garbled) line; that is data
+    loss that already happened, not a reason to refuse the rest of the
+    flight record — the valid PREFIX is returned and ``.skipped`` counts the
+    non-blank lines abandoned from the first undecodable one onward.  Prefix
+    (not skip-and-continue) semantics are deliberate: past a corruption
+    point, later "valid-looking" lines may be interleaved fragments, and a
+    diagnostic record must not resurrect them as facts."""
+    out = JsonlRecords()
+    corrupt = False
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            if corrupt:
+                out.skipped += 1
+                continue
+            try:
                 out.append(TraceRecord.from_dict(json.loads(line)))
+            except (json.JSONDecodeError, KeyError, TypeError):
+                corrupt = True
+                out.skipped += 1
     return out
 
 
@@ -281,14 +358,16 @@ def mesh_metadata() -> dict:
         return {"platform": "unknown", "device_count": 0, "process_count": 1}
 
 
-def start_trace(kind: str) -> dict:
-    """Begin-of-operation token: id, wall-clock anchors, compile-log mark."""
+def start_trace(kind: str, parent_id: Optional[str] = None) -> dict:
+    """Begin-of-operation token: id, wall-clock anchors, compile-log mark.
+    ``parent_id`` defaults to the request id in scope (:func:`parent_scope`)."""
     return {
         "kind": kind,
         "trace_id": RECORDER.next_trace_id(kind),
         "started_at": time.time(),
         "t0": time.monotonic(),
         "compile_mark": compile_mark(),
+        "parent_id": parent_id if parent_id is not None else _PARENT_ID.get(),
     }
 
 
@@ -299,7 +378,18 @@ def finish_trace(
 ) -> Optional[TraceRecord]:
     """Close a :func:`start_trace` token and record it.  Never raises —
     observability must not break the operation it observes — so emitting
-    call sites (optimizer, executor, detector, monitor) need no guard."""
+    call sites (optimizer, executor, detector, monitor) need no guard.
+
+    Trace boundaries double as the device-memory sampling points: the
+    profiler's per-device gauges (peak/in-use) are refreshed here, host-side,
+    so a long-lived server tracks its HBM watermark without any polling
+    thread or added dispatches."""
+    try:
+        from cruise_control_tpu.obs.profiler import PROFILER
+
+        PROFILER.sample_memory()
+    except Exception:
+        pass
     try:
         return RECORDER.record(
             TraceRecord(
@@ -311,6 +401,7 @@ def finish_trace(
                 attrs=attrs or {},
                 spans=spans or [],
                 compile_events=compile_events_since(token["compile_mark"]),
+                parent_id=token.get("parent_id"),
             )
         )
     except Exception:

@@ -15,6 +15,13 @@ never a semantics change.  This is the component the reference cannot express:
 its analyzer is a single-JVM sequential walk (GoalOptimizer.java:435-524, scale
 ceiling ~10k brokers at minutes of wall clock); here the same goal semantics run
 SPMD over every chip of a slice.
+
+Telemetry: the sharded path dispatches the SAME profiled jit objects as the
+single-device optimizer (``obs/profiler.py`` wraps them at module level), so
+``/METRICS`` reports its per-program call counts, attributed compiles and
+HLO cost under the same ``optimizer.*`` program names — sharded-input
+signatures simply appear as additional shape entries, and the per-device
+``memory_stats()`` gauges cover every mesh device at trace boundaries.
 """
 
 from __future__ import annotations

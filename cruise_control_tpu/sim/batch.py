@@ -56,6 +56,7 @@ from cruise_control_tpu.analyzer.optimizer import (
 )
 from cruise_control_tpu.core.resources import Resource
 from cruise_control_tpu.model.arrays import ClusterArrays
+from cruise_control_tpu.obs.profiler import PROFILER, profile_jit
 from cruise_control_tpu.ops.segments import segment_sum as _segment_sum
 from cruise_control_tpu.sim.scenario import Scenario, ScenarioBatch, build_batch
 
@@ -119,8 +120,7 @@ def _hard_satisfiability(state: ClusterArrays, ctx: GoalContext):
     return sat, needed
 
 
-@partial(jax.jit, static_argnames=("enable_heavy", "subset"))
-def _sweep_kernel(states, ctx, enable_heavy=False, subset=None):
+def _sweep_kernel_fn(states, ctx, enable_heavy=False, subset=None):
     """ONE dispatch: per-scenario violations + satisfiability + movement floor."""
 
     def one(state):
@@ -133,6 +133,15 @@ def _sweep_kernel(states, ctx, enable_heavy=False, subset=None):
         return viol, sat, needed, n_off, off_bytes
 
     return jax.vmap(one)(states)
+
+
+# registered with the executable profiler (obs/profiler.py): per-sweep-shape
+# FLOPs/bytes, call counts and attributed compiles land in /METRICS alongside
+# the optimizer's programs
+_sweep_kernel = profile_jit(
+    "sim.sweep_kernel",
+    partial(jax.jit, static_argnames=("enable_heavy", "subset"))(_sweep_kernel_fn),
+)
 
 
 # -- executable-shape accounting ----------------------------------------------------
@@ -307,6 +316,7 @@ def fast_sweep(
     from cruise_control_tpu.obs import recorder as obs
 
     token = obs.start_trace("simulate")
+    cost_mark = PROFILER.mark()
     t0 = time.monotonic()
     goal_ids = tuple(goal_ids)
     hard_ids = tuple(hard_ids)
@@ -350,7 +360,10 @@ def fast_sweep(
             obs.Span("build-batch", "setup", build_s, 0),
             obs.Span("sweep", "sweep", sweep_s, 1),
         ],
-        attrs=_trace_attrs(result, goal_ids, mesh),
+        attrs={
+            **_trace_attrs(result, goal_ids, mesh),
+            "cost": PROFILER.cost_since(cost_mark),
+        },
     )
     return result
 

@@ -21,6 +21,9 @@ python -m pytest tests/ -x -q -m chaos
 echo "== sim sweep smoke (64-scenario capacity sweep: ≤2 dispatches, 0 warm compiles) =="
 python scripts/bench_sim.py --repeats 1 >/dev/null
 
+echo "== metrics lint (boot app on fake backend, scrape /METRICS, strict exposition parse) =="
+python -m pytest tests/test_telemetry.py -q -k "metrics_lint or content_type"
+
 echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check) =="
 python scripts/bench_gate.py
 

@@ -69,6 +69,41 @@ class TestRecorder:
         rec.record(_sample_trace(kind="execution"))
         assert [t.kind for t in rec.recent(10, kind="execution")] == ["execution"]
 
+    def test_multi_record_trim_counts_every_drop(self):
+        """ISSUE-5 satellite: a trim that deletes N records must add N to the
+        drop counter, not 1 — a capacity shrink mid-flight used to undercount."""
+        rec = FlightRecorder(capacity=10)
+        for i in range(10):
+            rec.record(_sample_trace())
+        rec.capacity = 4        # operator shrinks the ring on a live recorder
+        rec.record(_sample_trace())
+        snap = rec.snapshot()
+        assert snap["size"] == 4
+        assert snap["dropped"] == 7   # 11 recorded, 4 kept
+
+    def test_read_jsonl_tolerates_truncated_trailing_line(self, tmp_path):
+        """ISSUE-5 satellite: a crash mid-append leaves a partial JSON line;
+        the reader returns the valid prefix + a skipped count instead of
+        raising JSONDecodeError."""
+        path = str(tmp_path / "flight.jsonl")
+        rec = FlightRecorder(jsonl_path=path)
+        rec.record(_sample_trace())
+        rec.record(_sample_trace(kind="execution"))
+        whole = open(path).read()
+        # simulate the crash: the last line only half-written
+        open(path, "w").write(whole[: len(whole) - 40].rstrip("\n") + "\n")
+        loaded = read_jsonl(path)
+        assert len(loaded) == 1
+        assert loaded[0].kind == "optimize"
+        assert loaded.skipped == 1
+
+    def test_read_jsonl_clean_sink_reports_zero_skipped(self, tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        rec = FlightRecorder(jsonl_path=path)
+        rec.record(_sample_trace())
+        loaded = read_jsonl(path)
+        assert len(loaded) == 1 and loaded.skipped == 0
+
     def test_jsonl_round_trip(self, tmp_path):
         path = str(tmp_path / "flight.jsonl")
         rec = FlightRecorder(jsonl_path=path)
@@ -469,7 +504,32 @@ class TestGateEndToEnd:
         assert doc["schema"] == gate_mod.GATE_SCHEMA
         for tier in gate_mod.DEFAULT_TIERS:
             assert tier in doc["tiers"], f"no committed baseline for {tier}"
-            assert doc["tiers"][tier]["residual_hard_violations"] == 0.0
+            assert doc["tiers"][tier]["wall_s"] > 0
+            if gate_mod.TIERS[tier].runner is None:   # solver tiers only
+                assert doc["tiers"][tier]["residual_hard_violations"] == 0.0
+
+
+class TestExporterGateTier:
+    """ISSUE-5 satellite: the scrape path gates its own render wall."""
+
+    def test_run_tier_measures_render_batch(self):
+        m = gate_mod.run_tier("exporter")
+        assert m["tier"] == "exporter"
+        assert m["wall_s"] > 0
+        assert m["series"] > 400        # fully-populated registry
+        assert m["metric_families"] >= 10
+
+    def test_render_regression_fails_compare(self):
+        base = {"tier": "exporter", "wall_s": 1.0}
+        ok = gate_mod.compare(base, {"tier": "exporter", "wall_s": 1.2})
+        assert ok == []
+        fails = gate_mod.compare(base, {"tier": "exporter", "wall_s": 2.0})
+        assert any("wall" in f for f in fails)
+
+    def test_inject_sleep_hook_applies(self):
+        fast = gate_mod.run_tier("exporter")
+        slow = gate_mod.run_tier("exporter", inject_sleep_s=0.5)
+        assert slow["wall_s"] >= fast["wall_s"] + 0.4
 
 
 # -- satellite regressions ----------------------------------------------------------

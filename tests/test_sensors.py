@@ -1,0 +1,161 @@
+"""Direct coverage for ``core/sensors.py``.
+
+The registry has been load-bearing since PR 1 (STATE surface, gate numbers,
+now the /METRICS exposition) but was only exercised through its consumers;
+these tests pin the primitives themselves: Timer percentile edges, Meter
+window decay, Counter/Gauge snapshots, registry prefix filtering and the
+concurrent-``setdefault`` contract.
+"""
+
+import threading
+
+import pytest
+
+from cruise_control_tpu.core import sensors as S
+from cruise_control_tpu.core.sensors import (
+    Counter,
+    Gauge,
+    Meter,
+    SensorRegistry,
+    Timer,
+)
+
+
+class TestTimer:
+    def test_empty_ring_percentiles_are_zero(self):
+        t = Timer()
+        snap = t.snapshot()
+        assert snap["count"] == 0
+        assert snap["mean_s"] == 0.0
+        assert snap["p50_s"] == 0.0
+        assert snap["p95_s"] == 0.0
+
+    def test_single_sample_is_every_percentile(self):
+        t = Timer()
+        t.update(0.25)
+        snap = t.snapshot()
+        assert snap["count"] == 1
+        assert snap["p50_s"] == 0.25
+        assert snap["p95_s"] == 0.25
+        assert snap["max_s"] == snap["last_s"] == 0.25
+
+    def test_window_overflow_drops_oldest(self):
+        t = Timer(window=4)
+        for v in (10.0, 1.0, 2.0, 3.0, 4.0):   # the 10.0 falls off the ring
+            t.update(v)
+        assert len(t._ring) == 4
+        assert 10.0 not in t._ring
+        # count/total/max are lifetime stats, NOT windowed
+        assert t.snapshot()["count"] == 5
+        assert t.snapshot()["max_s"] == 10.0
+        # percentiles come from the surviving window only
+        assert t._percentile(1.0) == 4.0
+
+    def test_percentile_indexing_edges(self):
+        t = Timer()
+        for v in (1.0, 2.0, 3.0, 4.0):
+            t.update(v)
+        assert t._percentile(0.0) == 1.0
+        assert t._percentile(0.5) == 3.0     # idx = int(0.5*4) = 2 (sorted)
+        assert t._percentile(1.0) == 4.0     # clamped to len-1
+
+    def test_context_manager_records_a_duration(self):
+        t = Timer()
+        with t.time():
+            pass
+        assert t.count == 1
+        assert t.last_s >= 0.0
+
+
+class _FakeTime:
+    """Deterministic stand-in for the module's ``time`` (monotonic only)."""
+
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def monotonic(self):
+        return self.now
+
+
+class TestMeter:
+    def test_rate_decays_past_window(self, monkeypatch):
+        clock = _FakeTime()
+        monkeypatch.setattr(S, "time", clock)
+        m = Meter(window_s=60.0)
+        m.mark(6)
+        assert m.snapshot()["rate_per_s"] == pytest.approx(6 / 60.0)
+        clock.now += 30.0
+        assert m.snapshot()["rate_per_s"] == pytest.approx(6 / 60.0)
+        clock.now += 31.0                    # events now older than window_s
+        assert m.snapshot()["rate_per_s"] == 0.0
+        assert m.snapshot()["total"] == 6    # total is lifetime, not windowed
+
+    def test_mark_trims_stale_events(self, monkeypatch):
+        clock = _FakeTime()
+        monkeypatch.setattr(S, "time", clock)
+        m = Meter(window_s=10.0)
+        m.mark(3)
+        clock.now += 11.0
+        m.mark(2)                            # trims the 3 stale timestamps
+        assert len(m._events) == 2
+        assert m.snapshot()["rate_per_s"] == pytest.approx(2 / 10.0)
+
+
+class TestCounterGauge:
+    def test_counter_monotonic_and_batched(self):
+        c = Counter()
+        c.inc()
+        c.inc(41)
+        assert c.snapshot() == 42
+
+    def test_gauge_last_write_wins(self):
+        g = Gauge()
+        g.set(1.5)
+        g.set(-2)
+        assert g.snapshot() == -2.0
+        assert isinstance(g.snapshot(), float)
+
+
+class TestRegistry:
+    def test_prefix_filtering(self):
+        reg = SensorRegistry()
+        reg.counter("Executor.execution-started").inc()
+        reg.counter("LoadMonitor.samples").inc(2)
+        reg.timer("Executor.proposal-execution-timer").update(0.1)
+        snap = reg.snapshot(prefix="Executor.")
+        assert set(snap["counters"]) == {"Executor.execution-started"}
+        assert set(snap["timers"]) == {"Executor.proposal-execution-timer"}
+        assert "gauges" not in snap          # empty groups are omitted
+        full = reg.snapshot()
+        assert set(full["counters"]) == {
+            "Executor.execution-started", "LoadMonitor.samples",
+        }
+
+    def test_same_name_returns_same_sensor(self):
+        reg = SensorRegistry()
+        assert reg.counter("X.a") is reg.counter("X.a")
+        assert reg.timer("X.t") is reg.timer("X.t")
+        # kinds are namespaced separately: a timer and a counter may share a name
+        assert reg.gauge("X.a") is not reg.counter("X.a")
+
+    def test_concurrent_setdefault_yields_one_instance(self):
+        """N threads racing registry.counter(name) must all get THE instance —
+        increments from every thread land on one value."""
+        reg = SensorRegistry()
+        seen = []
+        barrier = threading.Barrier(8)
+
+        def worker():
+            barrier.wait()
+            c = reg.counter("Race.counter")
+            seen.append(c)
+            for _ in range(1000):
+                c.inc()
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len({id(c) for c in seen}) == 1
+        assert reg.counter("Race.counter").snapshot() == 8000

@@ -1,0 +1,477 @@
+"""Telemetry-plane tests (ISSUE 5): exposition, profiler, correlated tracing.
+
+Acceptance criteria covered here:
+
+* ``GET /METRICS`` returns parser-valid Prometheus text covering every sensor
+  registered during a rebalance + sweep session (``test_metrics_lint_*``:
+  round-trips the live page through the strict exposition parser);
+* a warm optimize with the profiler enabled adds zero dispatches and zero
+  compile events — asserted from the obs flight record — while its trace
+  carries flops/bytes/memory-watermark cost attrs;
+* one ``X-Request-Id`` sent to POST REBALANCE links the user task, the
+  optimize trace and the execution trace via ``GET /TRACES?parent_id=``.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cruise_control_tpu.core.sensors import REGISTRY, SensorRegistry
+from cruise_control_tpu.obs.exporter import (
+    ExpositionError,
+    parse_exposition,
+    render_prometheus,
+)
+from cruise_control_tpu.obs.profiler import PROFILER, DeviceProfiler, profile_jit
+from cruise_control_tpu.obs.recorder import (
+    RECORDER,
+    FlightRecorder,
+    TraceRecord,
+    current_parent_id,
+    parent_scope,
+)
+
+
+# -- exposition renderer -------------------------------------------------------------
+
+
+class TestExporterRender:
+    def _registry(self):
+        reg = SensorRegistry()
+        reg.timer("GoalOptimizer.proposal-computation-timer").update(0.5)
+        reg.gauge("AnomalyDetector.balancedness-score").set(98.5)
+        reg.counter("Executor.execution-started").inc(3)
+        reg.meter("AnomalyDetector.anomaly-rate").mark(2)
+        return reg
+
+    def test_round_trips_through_strict_parser(self):
+        text = render_prometheus(
+            registry=self._registry(),
+            recorder=FlightRecorder(),
+            profiler=DeviceProfiler(),
+        )
+        parsed = parse_exposition(text)
+        assert "cruise_control_tpu_timer_seconds" in parsed
+        assert "cruise_control_tpu_counter_total" in parsed
+        assert parsed["cruise_control_tpu_timer_seconds"]["type"] == "gauge"
+
+    def test_dot_families_become_labels(self):
+        text = render_prometheus(
+            registry=self._registry(),
+            recorder=FlightRecorder(),
+            profiler=DeviceProfiler(),
+        )
+        parsed = parse_exposition(text)
+        samples = parsed["cruise_control_tpu_counter_total"]["samples"]
+        labelsets = [dict(labels) for labels, _ in samples]
+        assert {"family": "Executor", "sensor": "execution-started"} in labelsets
+
+    def test_timer_stats_complete(self):
+        text = render_prometheus(
+            registry=self._registry(),
+            recorder=FlightRecorder(),
+            profiler=DeviceProfiler(),
+        )
+        parsed = parse_exposition(text)
+        stats = {
+            dict(labels)["stat"]
+            for labels, _ in parsed["cruise_control_tpu_timer_seconds"]["samples"]
+        }
+        assert stats == {"mean", "max", "last", "p50", "p95"}
+
+    def test_label_escaping_survives_parse(self):
+        reg = SensorRegistry()
+        reg.counter('Weird.name-with"quote\\and\nnewline').inc()
+        text = render_prometheus(
+            registry=reg, recorder=FlightRecorder(), profiler=DeviceProfiler()
+        )
+        parsed = parse_exposition(text)   # must not raise
+        samples = parsed["cruise_control_tpu_counter_total"]["samples"]
+        assert len(samples) == 1
+
+    def test_flight_recorder_summary_rendered(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(6):
+            rec.record(TraceRecord(
+                kind="optimize", trace_id=f"t{i}", started_at=0.0,
+                duration_s=0.1, platform="cpu",
+            ))
+        text = render_prometheus(
+            registry=SensorRegistry(), recorder=rec, profiler=DeviceProfiler()
+        )
+        parsed = parse_exposition(text)
+        ring = parsed["cruise_control_tpu_flight_ring_size"]["samples"]
+        dropped = parsed["cruise_control_tpu_flight_dropped_total"]["samples"]
+        assert ring[0][1] == 4.0
+        assert dropped[0][1] == 2.0
+
+    def test_profiler_totals_rendered(self):
+        prof = DeviceProfiler()
+        prof.on_call("optimizer.goal_step", ("k",), "sig", 0.01, [])
+        prof.set_analysis(("k",), {"flops": 100.0, "bytes accessed": 200.0})
+        prof.on_call("optimizer.goal_step", ("k",), "sig", 0.01, [])
+        text = render_prometheus(
+            registry=SensorRegistry(), recorder=FlightRecorder(), profiler=prof
+        )
+        parsed = parse_exposition(text)
+        flops = parsed["cruise_control_tpu_executable_flops_total"]["samples"]
+        assert dict(flops[0][0])["program"] == "optimizer.goal_step"
+        assert flops[0][1] == 200.0   # 100 flops × 2 calls
+
+    def test_gate_baseline_rendered(self):
+        text = render_prometheus(
+            registry=SensorRegistry(),
+            recorder=FlightRecorder(),
+            profiler=DeviceProfiler(),
+        )
+        parsed = parse_exposition(text)
+        tiers = {
+            dict(labels)["tier"]
+            for labels, _ in parsed["cruise_control_tpu_gate_baseline"]["samples"]
+        }
+        assert {"config1", "config2_small", "mesh8"} <= tiers
+
+
+# -- strict parser -------------------------------------------------------------------
+
+
+VALID = (
+    "# HELP m_a a counter\n"
+    "# TYPE m_a counter\n"
+    'm_a{x="1"} 2\n'
+)
+
+
+class TestExpositionParser:
+    def test_valid_text_parses(self):
+        parsed = parse_exposition(VALID)
+        assert parsed["m_a"]["samples"] == [((("x", "1"),), 2.0)]
+
+    def test_sample_without_type_rejected(self):
+        with pytest.raises(ExpositionError, match="without preceding"):
+            parse_exposition("# HELP m_a a\nm_a 1\n")
+
+    def test_sample_without_help_rejected(self):
+        with pytest.raises(ExpositionError, match="without preceding"):
+            parse_exposition("# TYPE m_a counter\nm_a 1\n")
+
+    def test_duplicate_series_rejected(self):
+        with pytest.raises(ExpositionError, match="duplicate series"):
+            parse_exposition(VALID + 'm_a{x="1"} 3\n')
+
+    def test_distinct_labelsets_allowed(self):
+        parse_exposition(VALID + 'm_a{x="2"} 3\n')
+
+    def test_duplicate_type_rejected(self):
+        with pytest.raises(ExpositionError, match="duplicate TYPE"):
+            parse_exposition("# TYPE m_a counter\n" + VALID)
+
+    def test_type_after_samples_rejected(self):
+        with pytest.raises(ExpositionError, match="after its samples"):
+            parse_exposition(VALID + "# TYPE m_a counter\n")
+
+    def test_bad_metric_name_rejected(self):
+        with pytest.raises(ExpositionError):
+            parse_exposition(
+                "# HELP 0bad x\n# TYPE 0bad counter\n0bad 1\n"
+            )
+
+    def test_bad_value_rejected(self):
+        with pytest.raises(ExpositionError, match="invalid value"):
+            parse_exposition(VALID.replace(" 2\n", " two\n"))
+
+    def test_illegal_escape_rejected(self):
+        bad = (
+            "# HELP m_b b\n# TYPE m_b gauge\n"
+            'm_b{x="a\\tb"} 1\n'          # \t is not a legal escape
+        )
+        with pytest.raises(ExpositionError, match="malformed"):
+            parse_exposition(bad)
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ExpositionError, match="unknown TYPE"):
+            parse_exposition("# HELP m_c c\n# TYPE m_c widget\nm_c 1\n")
+
+    def test_inf_nan_values_accepted(self):
+        parse_exposition(
+            "# HELP m_d d\n# TYPE m_d gauge\n"
+            'm_d{s="a"} +Inf\nm_d{s="b"} -Inf\nm_d{s="c"} NaN\n'
+        )
+
+
+# -- device/executable profiler ------------------------------------------------------
+
+
+class TestProfiler:
+    def test_wrapper_registers_and_analyzes(self):
+        prof_fn = profile_jit(
+            "test.square", jax.jit(lambda x: (x * x).sum())
+        )
+        x = jnp.arange(64, dtype=jnp.float32)
+        mark = PROFILER.mark()
+        out = prof_fn(x)
+        assert float(out) == float((x * x).sum())
+        cost = PROFILER.cost_since(mark)
+        assert cost["profiled_calls"] == 1
+        assert cost["flops"] > 0
+        assert "memory_peak_bytes" in cost
+        totals = PROFILER.per_program_totals()
+        assert totals["test.square"]["calls"] == 1
+        assert totals["test.square"]["flops_total"] > 0
+
+    def test_warm_calls_count_without_reanalysis(self):
+        prof_fn = profile_jit("test.add", jax.jit(lambda x: x + 1))
+        x = jnp.ones(8)
+        prof_fn(x)
+        entry_count = len(PROFILER.snapshot()["executables"])
+        for _ in range(3):
+            prof_fn(x)
+        snap = PROFILER.snapshot()
+        assert len(snap["executables"]) == entry_count   # no new signatures
+        adds = [e for e in snap["executables"] if e["program"] == "test.add"]
+        assert adds[0]["calls"] == 4
+
+    def test_new_shape_is_new_signature(self):
+        prof_fn = profile_jit("test.shapes", jax.jit(lambda x: x * 2))
+        prof_fn(jnp.ones(4))
+        prof_fn(jnp.ones(16))
+        sigs = [
+            e for e in PROFILER.snapshot()["executables"]
+            if e["program"] == "test.shapes"
+        ]
+        assert len(sigs) == 2
+
+    def test_disabled_profiler_is_transparent(self):
+        prof = PROFILER.enabled
+        try:
+            PROFILER.enabled = False
+            prof_fn = profile_jit("test.off", jax.jit(lambda x: x - 1))
+            prof_fn(jnp.ones(4))
+            assert not any(
+                e["program"] == "test.off"
+                for e in PROFILER.snapshot()["executables"]
+            )
+        finally:
+            PROFILER.enabled = prof
+
+    def test_memory_sampling_is_fallback_safe(self):
+        samples = PROFILER.sample_memory()
+        # CPU backends report no memory_stats — rows exist, values may be None
+        for row in samples:
+            assert "device" in row
+            assert "bytes_in_use" in row
+
+
+class TestWarmOptimizeWithProfiler:
+    """Acceptance: the profiler adds NOTHING to the warm path — dispatch
+    count and compile events unchanged (PR 4 budget) — while the optimize
+    trace carries the flops/bytes/memory cost block."""
+
+    @pytest.fixture(scope="class")
+    def warm_run(self):
+        from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
+        from cruise_control_tpu.analyzer import goals_base as G
+        from tests.fixtures import service_test_goals, unbalanced2
+
+        state, maps = unbalanced2().to_arrays()
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        goals = service_test_goals()
+        opt = GoalOptimizer(
+            goal_ids=goals,
+            hard_ids=tuple(g for g in goals if g in G.HARD_GOALS),
+            enable_heavy_goals=False,
+        )
+        assert PROFILER.enabled
+        _, cold = opt.optimize(state, ctx)
+        RECORDER.clear()
+        _, warm = opt.optimize(state, ctx)
+        trace = RECORDER.recent(1, kind="optimize")[0]
+        return goals, cold, warm, trace
+
+    def test_zero_extra_dispatches(self, warm_run):
+        goals, cold, warm, trace = warm_run
+        # the fused-dispatch budget: violations + 2 offline pre-phases +
+        # one per goal + trailing violations = #goals + 4
+        assert warm.num_dispatches == len(goals) + 4
+        assert warm.num_dispatches == cold.num_dispatches
+        assert trace.total_dispatches == warm.num_dispatches
+
+    def test_zero_compile_events_warm(self, warm_run):
+        _, _, _, trace = warm_run
+        assert trace.compile_events == []
+
+    def test_cost_attrs_on_trace(self, warm_run):
+        _, _, _, trace = warm_run
+        cost = trace.attrs["cost"]
+        assert cost["flops"] > 0
+        assert cost["bytes_accessed"] > 0
+        assert cost["profiled_calls"] >= trace.total_dispatches - 1
+        assert "memory_peak_bytes" in cost
+
+    def test_profiler_surfaces_optimizer_programs(self, warm_run):
+        programs = set(PROFILER.per_program_totals())
+        assert "optimizer.goal_step" in programs
+        assert "optimizer.phase" in programs
+        assert "optimizer.violations" in programs
+
+
+# -- request-correlated tracing ------------------------------------------------------
+
+
+class TestParentScope:
+    def test_scope_sets_and_restores(self):
+        assert current_parent_id() is None
+        with parent_scope("req-1"):
+            assert current_parent_id() == "req-1"
+            with parent_scope("req-2"):
+                assert current_parent_id() == "req-2"
+            assert current_parent_id() == "req-1"
+        assert current_parent_id() is None
+
+    def test_start_trace_inherits_scope(self):
+        from cruise_control_tpu.obs import recorder as obs
+
+        with parent_scope("req-xyz"):
+            token = obs.start_trace("detector")
+        trace = obs.finish_trace(token)
+        assert trace.parent_id == "req-xyz"
+
+    def test_recent_filters_by_parent_and_trace_id(self):
+        rec = FlightRecorder()
+        rec.record(TraceRecord(
+            kind="optimize", trace_id="a", started_at=0, duration_s=0,
+            platform="cpu", parent_id="p1",
+        ))
+        rec.record(TraceRecord(
+            kind="execution", trace_id="b", started_at=0, duration_s=0,
+            platform="cpu", parent_id="p1",
+        ))
+        rec.record(TraceRecord(
+            kind="optimize", trace_id="c", started_at=0, duration_s=0,
+            platform="cpu",
+        ))
+        assert {t.trace_id for t in rec.recent(10, parent_id="p1")} == {"a", "b"}
+        assert [t.trace_id for t in rec.recent(10, trace_id="c")] == ["c"]
+        assert rec.recent(10, kind="optimize", parent_id="p1")[0].trace_id == "a"
+
+    def test_parent_id_round_trips_jsonl(self, tmp_path):
+        from cruise_control_tpu.obs.recorder import read_jsonl
+
+        path = str(tmp_path / "f.jsonl")
+        rec = FlightRecorder(jsonl_path=path)
+        rec.record(TraceRecord(
+            kind="optimize", trace_id="a", started_at=0, duration_s=0,
+            platform="cpu", parent_id="p9",
+        ))
+        assert read_jsonl(path)[0].parent_id == "p9"
+
+
+# -- the served app: /METRICS + correlation over real HTTP ---------------------------
+
+
+@pytest.fixture(scope="module")
+def served():
+    from cruise_control_tpu.api.server import make_server
+    from cruise_control_tpu.client import CruiseControlClient
+    from tests.test_api import build_app
+
+    app = build_app()
+    server = make_server(app, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    client = CruiseControlClient(
+        f"http://127.0.0.1:{server.server_address[1]}", poll_timeout_s=600.0
+    )
+    yield app, client
+    server.shutdown()
+
+
+@pytest.mark.usefixtures("served")
+class TestServedTelemetry:
+    def test_request_id_walks_task_optimize_execution(self, served):
+        """Acceptance: ONE X-Request-Id sent to POST REBALANCE retrieves the
+        user task, the optimize trace and the execution trace."""
+        app, client = served
+        rid = "walk-me-7f3a"
+        out = client.rebalance(dryrun=False, wait=True, request_id=rid)
+        assert out is not None
+        body = client.traces(parent_id=rid, limit=50)
+        kinds = {t["kind"] for t in body["traces"]}
+        assert {"user_task", "optimize", "execution"} <= kinds, kinds
+        for t in body["traces"]:
+            assert t["parent_id"] == rid
+        # the user task also reports the id
+        tasks = client.user_tasks()["userTasks"]
+        assert any(t.get("RequestId") == rid for t in tasks)
+
+    def test_generated_request_id_echoed(self, served):
+        _, client = served
+        status, _, headers = client._request("GET", "state")
+        assert status == 200
+        assert headers.get("X-Request-Id", "").startswith("req-")
+
+    def test_metrics_lint_full_session_coverage(self, served):
+        """Acceptance + CI metrics-lint: after a rebalance + sweep session the
+        /METRICS page is strictly parser-valid and covers EVERY registered
+        sensor (timers, gauges, counters, meters)."""
+        app, client = served
+        client.simulate(add_broker_counts=[0, 1], load_factors=[1.0, 1.25])
+        text = client.metrics()
+        parsed = parse_exposition(text)
+
+        by_family = {
+            "timers": "cruise_control_tpu_timer_count",
+            "gauges": "cruise_control_tpu_gauge",
+            "counters": "cruise_control_tpu_counter_total",
+            "meters": "cruise_control_tpu_meter_total",
+        }
+        snap = REGISTRY.snapshot()
+        for kind, metric in by_family.items():
+            exported = {
+                (dict(labels)["family"], dict(labels)["sensor"])
+                for labels, _ in parsed.get(metric, {"samples": []})["samples"]
+            }
+            for name in snap.get(kind, {}):
+                fam, _, leaf = name.partition(".")
+                key = (fam, leaf) if leaf else ("", fam)
+                assert key in exported, f"{kind} sensor {name} missing from page"
+        # the session's signature sensors all made it
+        counters = {
+            dict(labels)["sensor"]
+            for labels, _ in parsed["cruise_control_tpu_counter_total"]["samples"]
+        }
+        assert "sweeps" in counters            # ScenarioPlanner.sweeps
+        assert "traces-recorded" in counters   # FlightRecorder
+        # profiled executables + scrape self-metrics are on the page
+        assert "cruise_control_tpu_executable_calls_total" in parsed
+        timers = {
+            dict(labels)["sensor"]
+            for labels, _ in parsed["cruise_control_tpu_timer_count"]["samples"]
+        }
+        assert "render-timer" in timers        # MetricsExporter.render-timer
+
+    def test_metrics_content_type_plain_text(self, served):
+        app, client = served
+        import urllib.request
+
+        url = f"{client.base_url}/kafkacruisecontrol/metrics"
+        with urllib.request.urlopen(url) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            assert b"# TYPE cruise_control_tpu_" in resp.read()
+
+    def test_state_carries_profiler_block(self, served):
+        from cruise_control_tpu.api.schemas import validate_endpoint
+
+        app, client = served
+        body = client.state()
+        validate_endpoint("STATE", body)
+        assert body["Profiler"]["enabled"] is True
+        assert isinstance(body["Profiler"]["executables"], list)
+
+    def test_traces_endpoint_schema_with_parent(self, served):
+        from cruise_control_tpu.api.schemas import validate_endpoint
+
+        app, client = served
+        body = client.traces(limit=10)
+        validate_endpoint("TRACES", body)
