@@ -286,6 +286,12 @@ class OptimizerResult:
     #: jitted-computation dispatches issued by this optimize() — the host↔device
     #: round-trip budget that dominates wall-clock on a network-tunneled device
     num_dispatches: int = 0
+    #: the per-request deadline (optimize.deadline.ms) expired mid-walk: the
+    #: placement is the best-so-far state after the goals that DID run (their
+    #: reports are present; later goals never started).  Surfaced in the
+    #: REST response and the optimize trace so a capped answer is never
+    #: mistaken for a full solve
+    degraded: bool = False
 
     @property
     def violated_hard_goals(self) -> List[str]:
@@ -692,7 +698,13 @@ class GoalOptimizer:
         enable_heavy_goals: bool = True,
         fuse_goal_dispatch: bool | None = None,
         bucket_brokers: bool | None = None,
+        deadline_s: Optional[float] = None,
     ) -> None:
+        #: per-request wall budget (optimize.deadline.ms): checked between
+        #: goal steps; on expiry the walk stops and the best-so-far placement
+        #: returns marked ``degraded`` instead of hanging the request — the
+        #: first mitigation for the MULTICHIP_r04-style stall (ROADMAP #3)
+        self.deadline_s = deadline_s
         self.enable_heavy_goals = enable_heavy_goals
         self.goal_ids = tuple(
             g for g in goal_ids if enable_heavy_goals or g not in G.HEAVY_GOALS
@@ -893,11 +905,27 @@ class GoalOptimizer:
         # become the "setup" span; each goal's enqueue delta becomes its span
         setup_dispatches = dispatches
         setup_s = time.monotonic() - t0
+        degraded = False
         try:
             raw: List[tuple] = []
             unassigned = None
             prior: Tuple[int, ...] = ()
             for gid in self.goal_ids:
+                if (
+                    self.deadline_s is not None
+                    and time.monotonic() - t0 >= self.deadline_s
+                ):
+                    # deadline expired between goal steps: stop the walk and
+                    # return the best-so-far placement marked degraded — the
+                    # goals already walked keep their reports, the rest never
+                    # start (a half-run goal could violate an earlier one)
+                    from cruise_control_tpu.core.sensors import (
+                        OPTIMIZE_DEADLINE_COUNTER,
+                    )
+
+                    REGISTRY.counter(OPTIMIZE_DEADLINE_COUNTER).inc()
+                    degraded = True
+                    break
                 g0 = time.monotonic()
                 d0 = dispatches
                 if gid == G.KAFKA_ASSIGNER_RACK:
@@ -1072,6 +1100,7 @@ class GoalOptimizer:
             duration_s=time.monotonic() - t0,
             movement=movement_stats(initial, state),
             num_dispatches=dispatches,
+            degraded=degraded,
         )
         REGISTRY.timer(PROPOSAL_COMPUTATION_TIMER).update(result.duration_s)
 
@@ -1112,6 +1141,7 @@ class GoalOptimizer:
                 "residual_soft_violations": result.residual_soft_violations,
                 "balancedness": result.balancedness_score,
                 "provision_status": provision.status,
+                "degraded": degraded,
                 "fused_dispatch": fused,
                 "fast_mode": bool(ctx.fast_mode),
                 "stamps_supported": stamps_ok,

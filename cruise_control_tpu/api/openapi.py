@@ -86,6 +86,14 @@ _ENDPOINT_PARAMS = {
          "schema": {"type": "integer"},
          "description": "cap on extra brokers the capacity sweep may probe"},
     ],
+    "HEALTHZ": [
+        {"name": "readiness", "in": "query", "required": False,
+         "schema": {"type": "boolean"},
+         "description": ("readinessProbe mode: 503 (+ Retry-After) until the "
+                         "startup ladder recovering -> monitor_warming -> "
+                         "ready completes; default liveness mode always "
+                         "answers 200 with the ladder state in the body")},
+    ],
     "TRACES": [
         {"name": "kind", "in": "query", "required": False,
          "schema": {"type": "string"},

@@ -103,6 +103,18 @@ _USER_TASK = {
     "?Progress": [dict],
     #: the creating request's X-Request-Id — GET /TRACES?parent_id=… walks it
     "?RequestId": str,
+    #: the completed task's final response body (also journal-replayed across
+    #: restarts, so a poll after a crash still gets its answer)
+    "?result": dict,
+    #: failure/interruption cause ("interrupted by process restart", …)
+    "?error": str,
+}
+
+_READINESS = {
+    "state": str,
+    "ready": bool,
+    "history": [{"state": str, "ts": float}],
+    "recovery": dict,
 }
 
 #: endpoint name (CruiseControlEndPoint.java:16-39) -> response schema
@@ -117,13 +129,17 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
             "executables": [dict],
             "memory": [dict],
         },
+        "?Readiness": _READINESS,
     },
+    "HEALTHZ": {"status": str, **_READINESS},
     "LOAD": {"brokers": [_BROKER_LOAD], "?hosts": [dict]},
     "PARTITION_LOAD": {"records": [dict], "?resource": str},
     "PROPOSALS": {
         "proposals": [_PROPOSAL],
         "?cached": bool,
         "?dryrun": bool,
+        #: true when optimize.deadline.ms expired mid-walk (best-so-far body)
+        "?degraded": bool,
         "?violations_before": dict,
         "?violations_after": dict,
         "?provision": (dict, str),

@@ -53,7 +53,7 @@ API_PREFIX = "/kafkacruisecontrol/"
 GET_ENDPOINTS = {
     "STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE",
     "USER_TASKS", "REVIEW_BOARD", "PERMISSIONS", "BOOTSTRAP", "TRAIN",
-    "TRACES", "METRICS",
+    "TRACES", "METRICS", "HEALTHZ",
 }
 #: endpoints whose 200 body is plain text, not JSON (Prometheus exposition)
 TEXT_ENDPOINTS = {"METRICS"}
@@ -66,6 +66,100 @@ POST_ENDPOINTS = {
 #: POSTs that change cluster state and thus go through two-step verification
 #: (SIMULATE is a pure what-if evaluation — nothing to review)
 REVIEWABLE = POST_ENDPOINTS - {"REVIEW", "SIMULATE"}
+#: optimize-family endpoints: anything that would build a cluster model and
+#: run the solver is refused with 503 + Retry-After until the process is
+#: ready (journal recovery finished, monitor windows warm) — the k8s-probe
+#: contract that keeps traffic off a replica that would only throw
+#: NotEnoughValidSnapshotsError or race its own recovery
+READINESS_GATED = {
+    "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
+    "FIX_OFFLINE_REPLICAS", "TOPIC_CONFIGURATION", "RIGHTSIZE",
+    "REMOVE_DISKS", "SIMULATE", "PROPOSALS",
+}
+
+
+class ReadinessState:
+    STARTING = "starting"
+    RECOVERING = "recovering"
+    MONITOR_WARMING = "monitor_warming"
+    READY = "ready"
+
+
+class ReadinessController:
+    """The startup readiness ladder: ``starting`` → ``recovering`` (journal
+    replay + backend reconciliation) → ``monitor_warming`` (until the load
+    monitor's completeness probe passes) → ``ready``.
+
+    Liveness and readiness are distinct: ``GET /healthz`` always answers
+    (liveness), its body — and the 503 gate on optimize-family endpoints —
+    carry the readiness state.  The ``monitor_warming`` → ``ready`` edge is
+    evaluated lazily on query via ``monitor_probe`` (no polling thread); the
+    explicit phases are set by the app shell.  Every transition is appended
+    to ``history`` so a post-hoc probe can verify the whole ladder ran."""
+
+    def __init__(self, monitor_probe=None, start_ready: bool = False) -> None:
+        self.monitor_probe = monitor_probe
+        self._lock = threading.Lock()
+        self._phase = ReadinessState.READY if start_ready else ReadinessState.STARTING
+        self.history: List[Tuple[str, float]] = [(self._phase, time.time())]
+        #: recovery accounting surfaced by /healthz and STATE (set by the app)
+        self.recovery: Dict[str, object] = {}
+        self._export_gauge()
+
+    def _export_gauge(self) -> None:
+        from cruise_control_tpu.core.sensors import READY_GAUGE, REGISTRY
+
+        REGISTRY.gauge(READY_GAUGE).set(
+            1.0 if self._phase == ReadinessState.READY else 0.0
+        )
+
+    def set_phase(self, phase: str) -> None:
+        with self._lock:
+            if phase != self._phase:
+                self._phase = phase
+                self.history.append((phase, time.time()))
+        self._export_gauge()
+
+    def current_phase(self, probe: bool = True) -> str:
+        """The ladder state.  ``probe=True`` may evaluate the monitor probe
+        (real backend metadata + aggregation work, warmup-only — once READY
+        is stored it never runs again) to flip ``monitor_warming`` →
+        ``ready``; ``probe=False`` never touches the backend — the LIVENESS
+        path, which must answer even when the backend hangs (a liveness
+        probe that blocks on a slow cluster gets the pod killed mid-warmup,
+        the exact failure this controller exists to prevent)."""
+        with self._lock:
+            phase = self._phase
+            fn = self.monitor_probe
+        if probe and phase == ReadinessState.MONITOR_WARMING and fn is not None:
+            ok = False
+            try:
+                ok = bool(fn())
+            except Exception:
+                ok = False
+            if ok:
+                self.set_phase(ReadinessState.READY)
+                return ReadinessState.READY
+        return phase
+
+    @property
+    def phase(self) -> str:
+        return self.current_phase(probe=True)
+
+    @property
+    def is_ready(self) -> bool:
+        return self.phase == ReadinessState.READY
+
+    def snapshot(self, probe: bool = True) -> dict:
+        phase = self.current_phase(probe=probe)
+        return {
+            "state": phase,
+            "ready": phase == ReadinessState.READY,
+            "history": [
+                {"state": s, "ts": round(ts, 3)} for s, ts in self.history
+            ],
+            "recovery": dict(self.recovery),
+        }
 
 
 def _qbool(params: Dict[str, List[str]], name: str, default: bool) -> bool:
@@ -96,6 +190,9 @@ def _op_result_json(op: OperationResult) -> dict:
     r = op.optimizer_result
     return {
         "dryrun": op.dryrun,
+        # deadline-expired solve: the placement is the best-so-far state, not
+        # the full goal walk (optimize.deadline.ms)
+        "degraded": r.degraded,
         "proposals": [
             {
                 "topic": p.tp[0],
@@ -151,13 +248,18 @@ class CruiseControlApp:
         security: Optional[SecurityProvider] = None,
         two_step_verification: bool = False,
         proposal_cache_ttl_s: float = 900.0,   # proposal.expiration.ms default
+        readiness: Optional[ReadinessController] = None,
+        user_task_journal=None,
     ) -> None:
         self.cc = cruise_control
         self.anomaly_manager = anomaly_manager
         self.provisioner = provisioner
         self.security = security or NoSecurityProvider()
         self.two_step = two_step_verification
-        self.user_tasks = UserTaskManager()
+        # embedded/test construction defaults to always-ready; the app shell
+        # passes its real readiness ladder
+        self.readiness = readiness or ReadinessController(start_ready=True)
+        self.user_tasks = UserTaskManager(journal=user_task_journal)
         self.purgatory = Purgatory()
         self.proposal_cache_ttl_s = proposal_cache_ttl_s
         self._proposal_cache: Optional[Tuple[float, dict]] = None
@@ -217,6 +319,25 @@ class CruiseControlApp:
         # device-cost surface (obs/profiler.py): per-executable FLOPs/bytes,
         # call counts, attributed compiles, memory watermark
         body["Profiler"] = PROFILER.snapshot()
+        # readiness ladder + recovery accounting (journal replay, wall)
+        body["Readiness"] = self.readiness.snapshot()
+        return 200, body
+
+    def get_healthz(self, params) -> Tuple[int, dict]:
+        """Liveness + readiness probe.  Always 200 when the process answers
+        (liveness); ``?readiness=true`` makes it a k8s readinessProbe — 503
+        until the startup ladder (recovering → monitor_warming → ready) is
+        done, so traffic stays off a replica mid-recovery.
+
+        Liveness mode never runs the monitor probe (``probe=False``): it must
+        answer from process state alone even when the backend is hung, or the
+        kubelet would kill a pod for its cluster's slowness.  Readiness mode
+        probes — that's what flips ``monitor_warming`` → ``ready``."""
+        readiness_mode = _qbool(params, "readiness", False)
+        snap = self.readiness.snapshot(probe=readiness_mode)
+        body = {"status": "alive", **snap}
+        if readiness_mode and not snap["ready"]:
+            return 503, body
         return 200, body
 
     def get_load(self, params) -> Tuple[int, dict]:
@@ -378,11 +499,14 @@ class CruiseControlApp:
         key = (endpoint, tuple(sorted((k, tuple(v)) for k, v in params.items())))
         # the request id in scope (handle() opened it) rides into the task so
         # the pool thread's traces correlate; a deduped resubmission keeps the
-        # first request's id — the task is one operation, whoever polls it
+        # first request's id — the task is one operation, whoever polls it.
+        # The formatter goes in WITH the work (not assigned afterwards): the
+        # journal embeds the serialized result in the completion record, and
+        # a fast task can finish before this function's next statement
         task = self.user_tasks.get_or_create(
-            endpoint, key, work, parent_id=obs.current_parent_id()
+            endpoint, key, work, parent_id=obs.current_parent_id(),
+            result_to_json=to_json,
         )
-        task.result_to_json = to_json   # USER_TASKS serves the final body
         headers = {"User-Task-ID": task.task_id}
         if task.status in (TaskStatus.COMPLETED, TaskStatus.COMPLETED_WITH_ERROR):
             try:
@@ -589,6 +713,13 @@ class CruiseControlApp:
         the executor thread — and is echoed back as a response header."""
         from cruise_control_tpu.obs import recorder as obs
 
+        # liveness/readiness probes run unauthenticated (k8s probes carry no
+        # credentials) and expose only the readiness ladder, never cluster data
+        if method == "GET" and endpoint == "HEALTHZ":
+            status, body = self.get_healthz(params)
+            headers_out = {} if status != 503 else {"Retry-After": "5"}
+            return status, body, headers_out
+
         try:
             user, role = self.security.authenticate(headers)
         except AuthenticationError as e:
@@ -609,6 +740,19 @@ class CruiseControlApp:
     def _dispatch_authorized(
         self, method: str, endpoint: str, params: Dict[str, List[str]], user, role
     ) -> Tuple[int, Union[dict, str], Dict[str, str]]:
+        if endpoint in READINESS_GATED and not self.readiness.is_ready:
+            # optimize-family requests are refused, not queued, until the
+            # readiness ladder completes — a solve against a recovering
+            # executor or an empty monitor window ring can only mislead
+            phase = self.readiness.phase
+            return (
+                503,
+                {
+                    "error": f"not ready: {phase}; retry after readiness",
+                    "readiness": phase,
+                },
+                {"Retry-After": "5"},
+            )
         try:
             if method == "GET":
                 if endpoint == "PERMISSIONS":

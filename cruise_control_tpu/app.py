@@ -20,8 +20,14 @@ from typing import Mapping, Optional
 from cruise_control_tpu.analyzer import goals_base as G
 from cruise_control_tpu.analyzer.constraint import BalancingConstraint
 from cruise_control_tpu.api.security import BasicSecurityProvider, SecurityProvider
-from cruise_control_tpu.api.server import CruiseControlApp, make_server
+from cruise_control_tpu.api.server import (
+    CruiseControlApp,
+    ReadinessController,
+    ReadinessState,
+    make_server,
+)
 from cruise_control_tpu.backend.base import ClusterBackend
+from cruise_control_tpu.core.journal import Journal
 from cruise_control_tpu.core.config import Config, ConfigException, resolve_class
 from cruise_control_tpu.core.config_defs import cruise_control_config
 from cruise_control_tpu.core.resources import Resource
@@ -37,7 +43,7 @@ from cruise_control_tpu.detector.detectors import (
 from cruise_control_tpu.detector.manager import AnomalyDetectorManager
 from cruise_control_tpu.detector.notifier import AnomalyNotifier
 from cruise_control_tpu.detector.provisioner import Provisioner
-from cruise_control_tpu.executor import Executor
+from cruise_control_tpu.executor import ExecutionJournal, Executor
 from cruise_control_tpu.executor.concurrency import ConcurrencyConfig
 from cruise_control_tpu.executor.engine import ExecutorNotifier
 from cruise_control_tpu.facade import CruiseControl
@@ -197,6 +203,23 @@ class CruiseControlTpuApp:
             min_samples_per_window=cfg.get("min.samples.per.partition.metrics.window"),
             sample_store=store if not cfg.get("skip.loading.samples") else None,
         )
+        # crash-recovery journals (journal.dir): the executor's execution WAL
+        # and the user-task WAL live side by side under one base directory so
+        # "restart on the same dirs" is one knob.  Empty = durability off.
+        jdir = cfg.get("journal.dir") or ""
+        self.execution_journal: Optional[ExecutionJournal] = None
+        self._user_task_journal: Optional[Journal] = None
+        if jdir:
+            jdir = os.path.expanduser(jdir)
+            jkw = dict(
+                max_segment_records=cfg.get("journal.max.segment.records"),
+                fsync=cfg.get("journal.fsync"),
+            )
+            self.execution_journal = ExecutionJournal(
+                Journal(os.path.join(jdir, "executor"), **jkw)
+            )
+            self._user_task_journal = Journal(os.path.join(jdir, "usertasks"), **jkw)
+
         max_retries = cfg.get("backend.request.max.retries")
         retry_policy = (
             RetryPolicy(
@@ -224,7 +247,10 @@ class CruiseControlTpuApp:
             retry_policy=retry_policy,
             task_timeout_s=(task_timeout_ms / 1000.0) if task_timeout_ms else None,
             rollback_stuck_tasks=cfg.get("execution.task.rollback.on.timeout"),
+            journal=self.execution_journal,
+            recovery_timeout_s=cfg.get("recovery.timeout.ms") / 1000.0,
         )
+        deadline_ms = cfg.get("optimize.deadline.ms")
         self.cruise_control = CruiseControl(
             backend,
             self.monitor,
@@ -232,6 +258,7 @@ class CruiseControlTpuApp:
             goal_ids=_goal_ids(cfg.get("default.goals"), G.DEFAULT_GOAL_ORDER),
             hard_ids=_goal_ids(cfg.get("hard.goals"), G.HARD_GOALS),
             constraint=_constraint(cfg),
+            optimize_deadline_s=(deadline_ms / 1000.0) if deadline_ms else None,
         )
 
         interval = cfg.get("anomaly.detection.interval.ms") / 1000.0
@@ -294,6 +321,16 @@ class CruiseControlTpuApp:
         self.anomaly_manager = AnomalyDetectorManager(
             self.cruise_control, notifier, detectors
         )
+        # readiness ladder: monitor_warming → ready flips once the window
+        # ring holds at least one valid window (the weakest completeness any
+        # model consumer needs) — evaluated lazily on probe, no poll thread
+        def _monitor_warm() -> bool:
+            try:
+                return self.monitor.state().num_valid_windows >= 1
+            except Exception:
+                return False
+
+        self.readiness = ReadinessController(monitor_probe=_monitor_warm)
         self.app = CruiseControlApp(
             self.cruise_control,
             anomaly_manager=self.anomaly_manager,
@@ -301,6 +338,8 @@ class CruiseControlTpuApp:
             security=_security(cfg),
             two_step_verification=cfg.get("two.step.verification.enabled"),
             proposal_cache_ttl_s=cfg.get("proposal.expiration.ms") / 1000.0,
+            readiness=self.readiness,
+            user_task_journal=self._user_task_journal,
         )
         self._server = None
         self._sampling_thread: Optional[threading.Thread] = None
@@ -309,7 +348,57 @@ class CruiseControlTpuApp:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, serve_http: bool = True) -> None:
-        """startUp(): begin sampling + detection (+ HTTP unless embedded)."""
+        """startUp(): crash recovery first, then sampling + detection (+ HTTP
+        unless embedded).  The readiness ladder walks ``recovering`` (journal
+        replay + backend reconciliation of interrupted executions) →
+        ``monitor_warming`` → ``ready`` (first valid window); optimize-family
+        endpoints 503 until the last step."""
+        from cruise_control_tpu.core.sensors import (
+            RECOVERY_RECORDS_GAUGE,
+            RECOVERY_WALL_GAUGE,
+            REGISTRY,
+        )
+
+        # the HTTP server comes up FIRST: /healthz must answer (liveness) and
+        # the readiness gate must 503 — not connection-refuse — while the
+        # recovery pass below runs, or a k8s livenessProbe would kill the pod
+        # mid-recovery on any journal large or stalled enough to outlast the
+        # probe budget
+        if serve_http:
+            self._server = make_server(
+                self.app,
+                self.config.get("webserver.http.address"),
+                self.config.get("webserver.http.port"),
+            )
+            threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+        t_rec = time.monotonic()
+        self.readiness.set_phase(ReadinessState.RECOVERING)
+        recovered, recovery_error = [], None
+        if self.execution_journal is not None:
+            # an unreadable journal must not strand a half-started process
+            # (HTTP already up, ladder pinned "recovering"): surface the
+            # error through /healthz and proceed — the journal stays on disk
+            # for the next restart to retry
+            try:
+                recovered = self.executor.recover()
+            except Exception as e:
+                recovery_error = f"{type(e).__name__}: {e}"
+        wall = time.monotonic() - t_rec
+        stats = self.executor.last_recovery_stats
+        records = (stats.records if stats else 0) + self.app.user_tasks.recovered_records
+        REGISTRY.gauge(RECOVERY_RECORDS_GAUGE).set(records)
+        REGISTRY.gauge(RECOVERY_WALL_GAUGE).set(wall)
+        self.readiness.recovery = {
+            "wall_s": round(wall, 3),
+            "records_replayed": records,
+            "executions_recovered": len(recovered),
+            "user_tasks_recovered": self.app.user_tasks.recovered_tasks,
+        }
+        if recovery_error is not None:
+            self.readiness.recovery["error"] = recovery_error
+        self.readiness.set_phase(ReadinessState.MONITOR_WARMING)
+
         self.cruise_control.start()
         self.anomaly_manager.start_detection()
         interval_s = self.config.get("metric.sampling.interval.ms") / 1000.0
@@ -333,13 +422,6 @@ class CruiseControlTpuApp:
         self._sampling_thread = threading.Thread(target=_sampling_loop, daemon=True)
         self._sampling_thread.start()
         self.app.start_proposal_refresher()
-        if serve_http:
-            self._server = make_server(
-                self.app,
-                self.config.get("webserver.http.address"),
-                self.config.get("webserver.http.port"),
-            )
-            threading.Thread(target=self._server.serve_forever, daemon=True).start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -347,6 +429,14 @@ class CruiseControlTpuApp:
         if self._server is not None:
             self._server.shutdown()
         self.anomaly_manager.shutdown()
+        # graceful shutdown seals the journals' active segments; an ungraceful
+        # drop leaves .open segments, which the next boot seals and replays
+        if self.execution_journal is not None:
+            try:
+                self.execution_journal.close()
+            except Exception:
+                pass
+        self.app.user_tasks.shutdown()
 
     @property
     def port(self) -> int:

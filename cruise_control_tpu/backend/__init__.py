@@ -18,13 +18,19 @@ from cruise_control_tpu.backend.base import (
     RawMetric,
     ReassignmentInProgress,
 )
-from cruise_control_tpu.backend.chaos import ChaosBackend, ChaosInjectedError, FaultPlan
+from cruise_control_tpu.backend.chaos import (
+    ChaosBackend,
+    ChaosInjectedError,
+    FaultPlan,
+    SimulatedCrash,
+)
 from cruise_control_tpu.backend.fake import FakeClusterBackend
 
 __all__ = [
     "BrokerInfo",
     "ChaosBackend",
     "ChaosInjectedError",
+    "SimulatedCrash",
     "ClusterBackend",
     "ClusterDescription",
     "FaultPlan",

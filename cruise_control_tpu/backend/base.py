@@ -105,6 +105,30 @@ class ClusterBackend(abc.ABC):
     def list_partition_reassignments(self) -> Dict[TopicPartition, Tuple[Tuple[int, ...], Tuple[int, ...]]]:
         """tp -> (adding, removing) broker sets still in flight."""
 
+    def list_ongoing_reassignments(self) -> Dict[TopicPartition, Tuple[int, ...]]:
+        """tp -> full TARGET replica set of every in-flight reassignment.
+
+        The recovery pass reconciles its journal against this: a journaled
+        task whose partition is still listed here is genuinely in flight on
+        the backend, whatever the journal last recorded.  Default derives the
+        target from metadata + (adding, removing); backends that track the
+        target directly should override."""
+        ongoing = self.list_partition_reassignments()
+        if not ongoing:
+            return {}
+        current = {
+            i.tp: i.replicas
+            for infos in self.describe_topics().values()
+            for i in infos
+        }
+        out: Dict[TopicPartition, Tuple[int, ...]] = {}
+        for tp, (adding, removing) in ongoing.items():
+            cur = current.get(tp, ())
+            out[tp] = tuple(b for b in cur if b not in removing) + tuple(
+                b for b in adding if b not in cur
+            )
+        return out
+
     @abc.abstractmethod
     def elect_leaders(self, partitions: Sequence[TopicPartition]) -> None:
         """Trigger preferred leader election for the partitions."""

@@ -23,6 +23,12 @@ Supported fault shapes (the ISSUE-2 chaos matrix):
   AlterPartitionReassignments-empty-target semantics) clears the stall.
 * ``metric_gap(start, end)`` — ``fetch_raw_metrics`` returns nothing for the
   ``[start, end)``-th fetch calls (a reporter-feed outage).
+* ``crash_after(method, n)`` — deterministic crash point: the first *n* calls
+  of ``method`` succeed, every later one raises
+  :class:`~cruise_control_tpu.core.journal.SimulatedCrash` (NOT retryable —
+  a crashing process is recovered, not retried).  Paired with
+  ``Journal.crash_after_appends``, recovery tests pin the process death at an
+  exact backend call / journal append.
 
 Injected errors are :class:`ChaosInjectedError`, a ``ConnectionError``
 subclass, so the default :class:`~cruise_control_tpu.core.retry.RetryPolicy`
@@ -48,7 +54,10 @@ from cruise_control_tpu.backend.base import (
     ReassignmentInProgress,
     TopicPartition,
 )
+from cruise_control_tpu.core.journal import SimulatedCrash
 from cruise_control_tpu.core.sensors import CHAOS_FAULTS_COUNTER, REGISTRY
+
+__all__ = ["ChaosBackend", "ChaosInjectedError", "FaultPlan", "SimulatedCrash"]
 
 
 class ChaosInjectedError(ConnectionError):
@@ -83,6 +92,8 @@ class FaultPlan:
         self.stall_budget = 0         # next-N reassigned partitions stall
         self.flaps: List[Tuple[int, int, int]] = []   # (broker, start, end)
         self.metric_gaps: List[Tuple[int, int]] = []  # [start, end) fetch calls
+        #: method -> call count after which every call raises SimulatedCrash
+        self.crash_points: Dict[str, int] = {}
 
     # -- error rules --------------------------------------------------------
 
@@ -128,6 +139,14 @@ class FaultPlan:
         self.metric_gaps.append((start_call, end_call))
         return self
 
+    def crash_after(self, method: str, n_calls: int) -> "FaultPlan":
+        """The first ``n_calls`` of ``method`` succeed; every later call
+        raises :class:`SimulatedCrash` — and keeps raising, because a dead
+        process doesn't come back until recovery restarts it.  ``"*"``
+        matches every method (total southbound blackout)."""
+        self.crash_points[method] = n_calls
+        return self
+
 
 class ChaosBackend(ClusterBackend):
     """Wraps any backend with the fault plan; unknown attributes (test helpers
@@ -160,6 +179,18 @@ class ChaosBackend(ClusterBackend):
             call_no = self.calls.get(method, 0) + 1
             self.calls[method] = call_no
             self.total_calls += 1
+            for key, count in (
+                (method, self.calls[method]),
+                ("*", self.total_calls),
+            ):
+                limit = self.plan.crash_points.get(key)
+                if limit is not None and count > limit:
+                    # crash points outrank every other fault: the process is
+                    # dead from here on, nothing else gets to fire
+                    self._record_fault(method, "crash", call_no)
+                    raise SimulatedCrash(
+                        f"injected crash point: {method} (call #{call_no})"
+                    )
             sleep_s = self.plan.latency_by_method.get(method, 0.0)
             exc: Optional[Exception] = None
             for rule in self.plan.error_rules:
@@ -281,6 +312,13 @@ class ChaosBackend(ClusterBackend):
         out = dict(self.inner.list_partition_reassignments())
         with self._lock:
             out.update({tp: (adding, removing) for tp, (_, adding, removing) in self._stalled.items()})
+        return out
+
+    def list_ongoing_reassignments(self) -> Dict[TopicPartition, Tuple[int, ...]]:
+        self._pre("list_ongoing_reassignments")
+        out = dict(self.inner.list_ongoing_reassignments())
+        with self._lock:
+            out.update({tp: target for tp, (target, _, _) in self._stalled.items()})
         return out
 
     def elect_leaders(self, partitions: Sequence[TopicPartition]) -> None:

@@ -313,6 +313,12 @@ class FakeClusterBackend(ClusterBackend):
                 tp: (r.adding, r.removing) for tp, r in self._reassignments.items()
             }
 
+    def list_ongoing_reassignments(self):
+        """tp -> target replica set (exact — the fake tracks targets)."""
+        with self._lock:
+            self._tick_reassignments()
+            return {tp: tuple(r.target) for tp, r in self._reassignments.items()}
+
     def _tick_reassignments(self) -> None:
         done = []
         for tp, r in self._reassignments.items():

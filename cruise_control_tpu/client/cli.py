@@ -37,6 +37,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="print the Prometheus text exposition page (GET /metrics)",
     )
 
+    hz = sub.add_parser(
+        "health",
+        help="liveness + readiness ladder (GET /healthz): recovering -> "
+             "monitor_warming -> ready, with recovery accounting",
+    )
+    hz.add_argument("--readiness", action="store_true",
+                    help="probe mode: exit 1 (HTTP 503) until the server is ready")
+
     tr = sub.add_parser(
         "traces", help="flight-recorder records, filterable by correlation id"
     )
@@ -126,6 +134,8 @@ def main(argv=None) -> int:
             # exposition format IS the output format — no JSON re-wrap
             print(client.metrics(), end="")
             return 0
+        elif ep == "health":
+            out = client.healthz(readiness=args.readiness)
         elif ep == "traces":
             out = client.traces(kind=args.kind, trace_id=args.trace_id,
                                 parent_id=args.parent_id, limit=args.limit)

@@ -189,6 +189,15 @@ class CruiseControlClient:
             raise ClientError(status, body)
         return body
 
+    def healthz(self, readiness: bool = False) -> Any:
+        """GET /healthz: liveness + the startup readiness ladder
+        (``recovering`` → ``monitor_warming`` → ``ready``).  With
+        ``readiness=True`` a not-ready server answers 503 (raised as
+        :class:`ClientError`) — the k8s readinessProbe contract."""
+        return self._get(
+            "healthz", readiness=str(readiness).lower() if readiness else None
+        )
+
     # -- POST endpoints (:27-39) ---------------------------------------------
 
     @staticmethod

@@ -133,6 +133,11 @@ def analyzer_config() -> ConfigDef:
              "deserialize the solver's compiled programs instead of paying "
              "the ~30-program cold compile (TPU-specific; empty = env "
              "CC_TPU_COMPILE_CACHE, unset = no persistent cache).")
+    d.define("optimize.deadline.ms", Type.LONG, None, M,
+             "Per-request optimize wall budget, checked between goal steps: "
+             "on expiry the best-so-far placement is returned marked "
+             "degraded=true instead of hanging the request (TPU-specific; "
+             "unset = no deadline).")
     d.define("profiler.enable", Type.BOOLEAN, True, L,
              "Device/executable profiler (obs/profiler.py): per-compiled-"
              "program FLOPs/bytes/call counts in STATE, /METRICS and trace "
@@ -181,6 +186,22 @@ def executor_config() -> ConfigDef:
     d.define("execution.task.rollback.on.timeout", Type.BOOLEAN, False, L,
              "Cancel a timed-out reassignment server-side so the partition "
              "reverts to its pre-move replica set.")
+    d.define("journal.dir", Type.STRING, "", H,
+             "Base directory of the crash-recovery journals (executor "
+             "execution WAL under <dir>/executor, user tasks under "
+             "<dir>/usertasks).  Empty = durability disabled: a crash "
+             "orphans in-flight reassignments and drops user tasks.")
+    d.define("journal.fsync", Type.STRING, "rotate", M,
+             "Journal fsync policy: 'always' (per append), 'rotate' "
+             "(at segment seal; default), 'never' (OS buffering only).")
+    d.define("journal.max.segment.records", Type.INT, 10_000, L,
+             "Records per journal segment before the atomic seal-and-rotate.",
+             in_range(lo=1))
+    d.define("recovery.timeout.ms", Type.LONG, 30_000, M,
+             "Wall budget of the startup resume-supervision loop: journaled "
+             "reassignments still moving past it get the stuck-task "
+             "treatment (DEAD, rolled back per "
+             "execution.task.rollback.on.timeout).", in_range(lo=1))
     return d
 
 

@@ -203,3 +203,12 @@ SIM_SWEEP_TIMER = "ScenarioPlanner.sweep-timer"
 PLANNER_FAILURES_COUNTER = "GoalViolationDetector.planner-failures"
 EXPORTER_RENDER_TIMER = "MetricsExporter.render-timer"
 METRICS_SCRAPES_COUNTER = "MetricsExporter.scrapes"
+JOURNAL_APPENDS_COUNTER = "Journal.records-appended"
+JOURNAL_SKIPPED_COUNTER = "Journal.replay-records-skipped"
+RECOVERY_EXECUTIONS_COUNTER = "Recovery.executions-recovered"
+RECOVERY_RECORDS_GAUGE = "Recovery.records-replayed"
+RECOVERY_WALL_GAUGE = "Recovery.wall-seconds"
+USER_TASKS_RECOVERED_COUNTER = "UserTaskManager.tasks-recovered"
+READY_GAUGE = "Readiness.ready"
+SAMPLE_STORE_SKIPPED_COUNTER = "SampleStore.replay-records-skipped"
+OPTIMIZE_DEADLINE_COUNTER = "GoalOptimizer.deadline-expirations"

@@ -15,6 +15,7 @@ from cruise_control_tpu.executor.engine import (
     ExecutorState,
     OngoingExecutionError,
 )
+from cruise_control_tpu.executor.journal import ExecutionJournal, OpenExecution
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
 from cruise_control_tpu.executor.strategy import (
     BaseReplicaMovementStrategy,
@@ -35,9 +36,11 @@ __all__ = [
     "ConcurrencyAdjuster",
     "ConcurrencyConfig",
     "ExecutionConcurrencyManager",
+    "ExecutionJournal",
     "ExecutionSummary",
     "ExecutionTask",
     "ExecutionTaskPlanner",
+    "OpenExecution",
     "Executor",
     "ExecutorNotifier",
     "ExecutorState",

@@ -25,6 +25,7 @@ from cruise_control_tpu.executor.concurrency import (
     ConcurrencyConfig,
     ExecutionConcurrencyManager,
 )
+from cruise_control_tpu.executor.journal import ExecutionJournal, OpenExecution
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
 from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy, StrategyContext
 from cruise_control_tpu.executor.tasks import ExecutionTask, TaskState, TaskType
@@ -94,6 +95,7 @@ class _RetryingBackend:
             "describe_logdirs",
             "alter_partition_reassignments",
             "list_partition_reassignments",
+            "list_ongoing_reassignments",
             "elect_leaders",
             "alter_replica_logdirs",
             "set_replication_throttles",
@@ -147,9 +149,17 @@ class Executor:
         retry_policy: Optional[RetryPolicy] = None,
         task_timeout_s: Optional[float] = None,
         rollback_stuck_tasks: bool = False,
+        journal: Optional[ExecutionJournal] = None,
+        recovery_timeout_s: float = 30.0,
     ) -> None:
         self.min_insync_replicas = min_insync_replicas
         self.retry_policy = retry_policy
+        #: execution WAL: accepted proposal set + every task transition
+        #: (None = no durability; a crash orphans in-flight reassignments)
+        self.journal = journal
+        #: wall budget of the startup resume-supervision loop: journaled
+        #: reassignments still moving past it get the stuck-task treatment
+        self.recovery_timeout_s = recovery_timeout_s
         #: in-flight tasks stuck longer than this are marked DEAD instead of
         #: spinning the phase to max_progress_checks (None = no timeout)
         self.task_timeout_s = task_timeout_s
@@ -179,6 +189,8 @@ class Executor:
         #: newer execution overwrites the summary before the next detector cycle
         self._degraded_summaries: List[ExecutionSummary] = []
         self._degraded_cap = 16
+        #: journal replay accounting of the last recover() (ReplayStats)
+        self.last_recovery_stats = None
 
     # -- public API ----------------------------------------------------------
 
@@ -225,12 +237,28 @@ class Executor:
         with self._lock:
             if self.has_ongoing_execution:
                 raise OngoingExecutionError("an execution is already in progress")
-            self._stop_signal.clear()
-            self._state = ExecutorState.STARTING_EXECUTION
             planner = ExecutionTaskPlanner(self.strategies, strategy_ctx)
             planner.add_proposals(list(proposals), logdir_moves=logdir_moves)
-            self._planner = planner
             execution_id = next(self._execution_ids)
+            if self.journal is not None:
+                # intent first (write-ahead): the accepted proposal set lands
+                # in the journal before any southbound call, so a crash at any
+                # later point can reconstruct what was being executed; every
+                # task transition then journals through the observer hook.
+                # This write precedes EVERY stored-state mutation — a refused
+                # journal (full disk) rejects the request without leaving a
+                # phantom STARTING_EXECUTION/_planner behind
+                self.journal.execution_started(
+                    execution_id, list(proposals), logdir_moves
+                )
+                for t in planner.all_tasks:
+                    t.observer = (
+                        lambda task, _id=execution_id:
+                        self.journal.task_transition(_id, task)
+                    )
+            self._stop_signal.clear()
+            self._state = ExecutorState.STARTING_EXECUTION
+            self._planner = planner
             self._execution_thread = threading.Thread(
                 target=self._run_execution,
                 args=(execution_id, planner, parent_id),
@@ -265,6 +293,234 @@ class Executor:
         if t is not None:
             t.join(timeout=timeout_s)
         return self._last_summary
+
+    # -- crash recovery ------------------------------------------------------
+
+    def recover(self) -> List[ExecutionSummary]:
+        """Startup recovery pass: replay the execution journal, reconcile
+        every interrupted execution against the backend's actual ongoing
+        reassignments, and close it out with exactly one recovered
+        :class:`ExecutionSummary` per execution — pushed through the
+        degraded-summary drain queue so the ``ExecutionFailureDetector``
+        reports the interruption like any other degraded run.
+
+        Per task, the backend is the truth and the journal the memory:
+
+        * journaled terminal states (COMPLETED/DEAD/ABORTED) stand;
+        * an inter-broker task journaled IN_PROGRESS whose partition is no
+          longer reassigning **completed while the process was down**;
+        * one still reassigning is genuinely in flight: it is rolled back
+          (cancel → DEAD, replicas revert) when ``rollback_stuck_tasks`` is
+          set, otherwise supervision resumes — bounded by
+          ``recovery_timeout_s``, after which the stuck-task policy applies;
+        * a PENDING task whose partition is reassigning toward exactly its
+          target launched before the crash outran the journal — it is
+          adopted as in-flight; any other PENDING task is aborted (recovery
+          never launches new work);
+        * leadership tasks re-trigger the idempotent preferred election once
+          their reorder (if any) is done; intra-broker (logdir) tasks caught
+          mid-call are unverifiable through the SPI and marked DEAD.
+
+        No-op without a journal.  Must run before the first execution."""
+        if self.journal is None:
+            return []
+        from cruise_control_tpu.core.sensors import (
+            RECOVERY_EXECUTIONS_COUNTER,
+            REGISTRY,
+        )
+
+        with self._lock:
+            if self.has_ongoing_execution:
+                raise OngoingExecutionError("cannot recover during an execution")
+        opens, stats = self.journal.open_executions()
+        self.last_recovery_stats = stats
+        if stats.max_execution_id:
+            # journaled ids survive the restart; never hand one out twice
+            self._execution_ids = iter(range(stats.max_execution_id + 1, 1 << 31))
+        summaries = []
+        for ex in opens:
+            summaries.append(self._recover_one(ex))
+            REGISTRY.counter(RECOVERY_EXECUTIONS_COUNTER).inc()
+        if opens:
+            # the crashed execution applied replication throttles it never got
+            # to clear (the live path clears them in its finally); on a real
+            # backend these are persistent configs that would silently cap
+            # replication forever.  Best-effort: a backend that can't clear
+            # still gets the recovered summaries
+            try:
+                self.backend.clear_replication_throttles()
+            except Exception:
+                pass
+        return summaries
+
+    def _recover_one(self, ex: OpenExecution) -> ExecutionSummary:
+        from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+        from cruise_control_tpu.obs import recorder as obs
+
+        token = obs.start_trace("recovery")
+        t0 = time.monotonic()
+
+        # -- reconstruct the task set exactly as the planner built it --------
+        tasks: List[ExecutionTask] = []
+        for p in ex.proposals:
+            if p.has_replica_action:
+                tasks.append(ExecutionTask(p, TaskType.INTER_BROKER_REPLICA_ACTION))
+            if p.has_leader_action:
+                tasks.append(ExecutionTask(p, TaskType.LEADER_ACTION))
+        by_tp = {p.tp: p for p in ex.proposals}
+        for (tp, broker), path in ex.logdir_moves.items():
+            p = by_tp.get(tp) or ExecutionProposal(
+                tp=tp, partition_size=0.0, old_leader=None,
+                old_replicas=(broker,), new_replicas=(broker,),
+            )
+            t = ExecutionTask(p, TaskType.INTRA_BROKER_REPLICA_ACTION)
+            t.logdir_move = (broker, path)
+            tasks.append(t)
+        for t in tasks:
+            st = ex.task_states.get((t.task_type.value, t.proposal.tp))
+            if st is not None:
+                t.state = st   # journal replay, not a transition
+            # recovery's own transitions journal like live ones
+            t.observer = (
+                lambda task, _id=ex.execution_id:
+                self.journal.task_transition(_id, task)
+            )
+
+        # -- reconcile against the backend's actual state ---------------------
+        # a backend that dies mid-reconciliation (past the retry budget) must
+        # degrade THIS execution's recovery — unresolved tasks land in the
+        # failed bucket and no finished record is written, so the next
+        # restart retries — never unwind app startup half-done
+        recovery_error: Optional[str] = None
+        in_flight: List[ExecutionTask] = []
+        adopted = completed_while_down = 0
+        resumed = rolled_back = 0
+        now = self._now_ms()
+        try:
+            ongoing = dict(self.backend.list_ongoing_reassignments())
+            for t in tasks:
+                if t.done:
+                    continue
+                tp = t.proposal.tp
+                if t.task_type is TaskType.INTER_BROKER_REPLICA_ACTION:
+                    target = ongoing.get(tp)
+                    if t.state is TaskState.PENDING:
+                        if target is not None and set(target) == set(t.proposal.new_replicas):
+                            # launched before the crash outran the journal write
+                            t.transition(TaskState.IN_PROGRESS, now)
+                            in_flight.append(t)
+                            adopted += 1
+                        else:
+                            t.transition(TaskState.ABORTED, now)
+                    elif t.state is TaskState.IN_PROGRESS:
+                        if target is None:
+                            t.transition(TaskState.COMPLETED, now)
+                            completed_while_down += 1
+                        else:
+                            in_flight.append(t)
+                    else:   # ABORTING: mid-cancel at crash time, unverifiable
+                        t.transition(TaskState.DEAD, now)
+                elif t.task_type is TaskType.LEADER_ACTION:
+                    if t.state is TaskState.PENDING:
+                        t.transition(TaskState.ABORTED, now)
+                    elif t.state is TaskState.IN_PROGRESS:
+                        if tp in ongoing:
+                            in_flight.append(t)   # replica-list reorder in flight
+                        else:
+                            # reorder done (or never submitted) — the preferred
+                            # election is idempotent, re-trigger and complete;
+                            # a refused election is a DEAD task, not a dead app
+                            try:
+                                self.backend.elect_leaders([tp])
+                                t.transition(TaskState.COMPLETED, now)
+                            except Exception:
+                                t.transition(TaskState.DEAD, now)
+                    else:
+                        t.transition(TaskState.DEAD, now)
+                else:   # intra-broker logdir move caught mid-call
+                    if t.state is TaskState.PENDING:
+                        t.transition(TaskState.ABORTED, now)
+                    else:
+                        t.transition(TaskState.DEAD, now)
+
+            # -- resume or roll back the genuinely in-flight reassignments ----
+            if in_flight and self.rollback_stuck_tasks:
+                for t in in_flight:
+                    self._kill_stuck_task(t, now)   # DEAD + server-side cancel
+                    rolled_back += 1
+                in_flight = []
+            elif in_flight:
+                deadline = time.monotonic() + self.recovery_timeout_s
+                while in_flight and time.monotonic() < deadline:
+                    still_ongoing = set(self.backend.list_partition_reassignments())
+                    still: List[ExecutionTask] = []
+                    now = self._now_ms()
+                    for t in in_flight:
+                        if t.proposal.tp not in still_ongoing:
+                            if t.task_type is TaskType.LEADER_ACTION:
+                                try:
+                                    self.backend.elect_leaders([t.proposal.tp])
+                                except Exception:
+                                    self._kill_stuck_task(t, now)
+                                    continue
+                            t.transition(TaskState.COMPLETED, now)
+                            resumed += 1
+                        else:
+                            still.append(t)
+                    in_flight = still
+                    if in_flight:
+                        time.sleep(self.progress_check_interval_s)
+                now = self._now_ms()
+                for t in in_flight:
+                    self._kill_stuck_task(t, now)
+        except Exception as e:
+            recovery_error = f"recovery reconciliation failed: {type(e).__name__}: {e}"
+
+        counts = {s: 0 for s in TaskState}
+        for t in tasks:
+            counts[t.state] += 1
+        summary = ExecutionSummary(
+            execution_id=ex.execution_id,
+            stopped=False,
+            completed=counts[TaskState.COMPLETED],
+            dead=counts[TaskState.DEAD],
+            aborted=counts[TaskState.ABORTED] + counts[TaskState.PENDING],
+            failed=counts[TaskState.IN_PROGRESS] + counts[TaskState.ABORTING],
+            duration_s=time.monotonic() - t0,
+            error=(
+                recovery_error
+                or "execution interrupted by process restart; recovered"
+            ),
+        )
+        with self._lock:
+            self._degraded_summaries.append(summary)
+            del self._degraded_summaries[: -self._degraded_cap]
+        self._last_summary = summary
+        if recovery_error is None:
+            # only a fully-reconciled execution gets its finished record; a
+            # degraded recovery leaves the journal open so the next restart
+            # retries the reconciliation against a (hopefully) live backend
+            try:
+                self.journal.execution_finished(summary, recovered=True)
+            except Exception:
+                pass
+        obs.finish_trace(
+            token,
+            attrs={
+                "execution_id": ex.execution_id,
+                "tasks": len(tasks),
+                "completed": summary.completed,
+                "dead": summary.dead,
+                "aborted": summary.aborted,
+                "failed": summary.failed,
+                "adopted": adopted,
+                "completed_while_down": completed_while_down,
+                "resumed": resumed,
+                "rolled_back": rolled_back,
+                "error": recovery_error,
+            },
+        )
+        return summary
 
     # -- execution phases ----------------------------------------------------
 
@@ -357,6 +613,15 @@ class Executor:
                     self._last_summary.duration_s
                 ),
             )
+            if self.journal is not None:
+                # guarded like every cleanup step: a journal that can no
+                # longer be written (disk full, simulated crash) must not
+                # skip the remaining teardown — the missing finished record
+                # is exactly what recovery keys on after a real crash
+                _cleanup(
+                    "journal_finish",
+                    lambda: self.journal.execution_finished(self._last_summary),
+                )
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
             obs.finish_trace(       # never raises (observability contract)
                 trace_token,

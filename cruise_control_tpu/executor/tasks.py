@@ -10,7 +10,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import itertools
-from typing import Optional
+from typing import Callable, Optional
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 
@@ -50,16 +50,33 @@ class ExecutionTask:
     end_ms: Optional[int] = None
     #: logdir destination for intra-broker moves: (broker, path)
     logdir_move: Optional[tuple] = None
+    #: transition hook (the execution journal): called with the task after
+    #: every state change.  An observer that raises aborts the transition's
+    #: caller — WAL semantics, a state change that cannot be journaled must
+    #: not proceed silently (this is also the chaos crash-point seam)
+    observer: Optional[Callable[["ExecutionTask"], None]] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def transition(self, new_state: TaskState, now_ms: int = 0) -> None:
         allowed = _VALID.get(self.state, set())
         if new_state not in allowed:
             raise ValueError(f"illegal task transition {self.state} -> {new_state}")
+        prev = (self.state, self.start_ms, self.end_ms)
         self.state = new_state
         if new_state is TaskState.IN_PROGRESS:
             self.start_ms = now_ms
         if new_state in (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD):
             self.end_ms = now_ms
+        if self.observer is not None:
+            try:
+                self.observer(self)
+            except BaseException:
+                # WAL semantics both ways: an unjournalable transition did not
+                # happen — reverting keeps memory and journal agreeing on the
+                # task's state, so a later recovery pass never double-counts
+                self.state, self.start_ms, self.end_ms = prev
+                raise
 
     @property
     def done(self) -> bool:

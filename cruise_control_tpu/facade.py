@@ -48,6 +48,7 @@ class CruiseControl:
         hard_ids: Sequence[int] = G.HARD_GOALS,
         constraint: Optional[BalancingConstraint] = None,
         enable_heavy_goals: bool = True,
+        optimize_deadline_s: Optional[float] = None,
     ) -> None:
         self.backend = backend
         self.monitor = monitor
@@ -56,6 +57,9 @@ class CruiseControl:
         self.hard_ids = tuple(hard_ids)
         self.constraint = constraint
         self.enable_heavy_goals = enable_heavy_goals
+        #: per-request optimize wall budget (optimize.deadline.ms): expired
+        #: solves return best-so-far placements marked degraded
+        self.optimize_deadline_s = optimize_deadline_s
         self._start_time = time.time()
 
     # -- lifecycle (KafkaCruiseControl.startUp) ------------------------------
@@ -83,6 +87,7 @@ class CruiseControl:
             goal_ids=tuple(goal_ids) if goal_ids is not None else self.goal_ids,
             hard_ids=tuple(hard_ids) if hard_ids is not None else self.hard_ids,
             enable_heavy_goals=self.enable_heavy_goals,
+            deadline_s=self.optimize_deadline_s,
         )
 
     def _context(

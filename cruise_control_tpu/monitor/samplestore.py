@@ -11,11 +11,10 @@ path on startup.  The TPU framework checkpoints to local newline-JSON segment fi
 from __future__ import annotations
 
 import abc
-import json
-import os
-import threading
-from typing import Callable, List, Optional
+import itertools
+from typing import Callable, List
 
+from cruise_control_tpu.core.journal import Journal
 from cruise_control_tpu.monitor.samples import (
     BrokerMetricSample,
     PartitionMetricSample,
@@ -44,76 +43,85 @@ class NoopSampleStore(SampleStore):
 
 
 class FileSampleStore(SampleStore):
-    """Append-only JSONL segments under a directory, replayed in order."""
+    """Checksummed JSONL segments on the generic WAL (``core/journal.py``).
 
-    def __init__(self, directory: str, max_segment_records: int = 100_000) -> None:
+    The write path inherits the journal's crash hardening: CRC-32 record
+    envelopes, atomic write-temp-then-rename segment rotation (a reader never
+    sees a half-sealed segment), and an fsync policy knob.  ``replay``
+    tolerates a crash-truncated or corrupted segment — the valid prefix is
+    ingested and the abandoned lines are counted (``last_replay_skipped`` +
+    the ``SampleStore.replay-records-skipped`` sensor), mirroring
+    ``read_jsonl``'s semantics instead of dying on ``JSONDecodeError`` and
+    taking monitor startup down with it.  Plain pre-envelope segments (older
+    stores) replay through the journal's legacy passthrough.
+    """
+
+    #: replay chunk: samples per SampleBatch handed to the consumer
+    REPLAY_CHUNK = 50_000
+
+    def __init__(
+        self,
+        directory: str,
+        max_segment_records: int = 100_000,
+        fsync: str = "never",
+    ) -> None:
         self.directory = directory
-        os.makedirs(directory, exist_ok=True)
-        self.max_segment_records = max_segment_records
-        self._lock = threading.Lock()
-        self._segment_idx = self._next_segment_index()
-        self._records_in_segment = 0
-        self._fh = None
-
-    def _next_segment_index(self) -> int:
-        existing = [
-            int(f.split(".")[0].split("-")[1])
-            for f in os.listdir(self.directory)
-            if f.startswith("segment-") and f.endswith(".jsonl")
-        ]
-        return max(existing, default=-1) + 1
-
-    def _segment_path(self, idx: int) -> str:
-        return os.path.join(self.directory, f"segment-{idx:06d}.jsonl")
+        self._journal = Journal(
+            directory, max_segment_records=max_segment_records, fsync=fsync
+        )
+        #: corrupt/truncated lines abandoned by the last replay
+        self.last_replay_skipped = 0
 
     def store(self, batch: SampleBatch) -> None:
-        with self._lock:
-            if self._fh is None or self._records_in_segment >= self.max_segment_records:
-                if self._fh:
-                    self._fh.close()
-                    self._segment_idx += 1
-                self._fh = open(self._segment_path(self._segment_idx), "a")
-                self._records_in_segment = 0
-            for s in batch.partition_samples:
-                self._fh.write(json.dumps(s.to_record()) + "\n")
-            for s in batch.broker_samples:
-                self._fh.write(json.dumps(s.to_record()) + "\n")
-            self._records_in_segment += len(batch)
-            self._fh.flush()
+        # one lock + one flush per batch, not per sample (the sampling loop's
+        # hot path)
+        self._journal.append_many(
+            s.to_record()
+            for s in itertools.chain(batch.partition_samples, batch.broker_samples)
+        )
 
     def replay(self, consumer: Callable[[SampleBatch], None]) -> int:
+        from cruise_control_tpu.core.sensors import (
+            REGISTRY,
+            SAMPLE_STORE_SKIPPED_COUNTER,
+        )
+
+        counts = {"skipped": 0, "segments": 0}
+        psamples: List[PartitionMetricSample] = []
+        bsamples: List[BrokerMetricSample] = []
         total = 0
-        with self._lock:
-            names = sorted(
-                f for f in os.listdir(self.directory)
-                if f.startswith("segment-") and f.endswith(".jsonl")
-            )
-        for name in names:
-            psamples: List[PartitionMetricSample] = []
-            bsamples: List[BrokerMetricSample] = []
-            with open(os.path.join(self.directory, name)) as fh:
-                for line in fh:
-                    rec = json.loads(line)
-                    if rec["type"] == "partition":
-                        psamples.append(
-                            PartitionMetricSample(
-                                (rec["topic"], rec["partition"]),
-                                rec["broker"],
-                                rec["ts"],
-                                tuple(rec["values"]),
-                            )
-                        )
-                    else:
-                        bsamples.append(
-                            BrokerMetricSample(rec["broker"], rec["ts"], tuple(rec["values"]))
-                        )
-            batch = SampleBatch(psamples, bsamples)
-            consumer(batch)
-            total += len(batch)
+
+        def flush() -> None:
+            nonlocal psamples, bsamples, total
+            if psamples or bsamples:
+                batch = SampleBatch(psamples, bsamples)
+                consumer(batch)
+                total += len(batch)
+                psamples, bsamples = [], []
+
+        # streaming: one segment at a time, chunked batches to the consumer —
+        # a long-lived store never materializes whole in memory
+        for rec in self._journal.replay_iter(counts):
+            if rec.get("type") == "partition":
+                psamples.append(
+                    PartitionMetricSample(
+                        (rec["topic"], rec["partition"]),
+                        rec["broker"],
+                        rec["ts"],
+                        tuple(rec["values"]),
+                    )
+                )
+            elif rec.get("type") == "broker":
+                bsamples.append(
+                    BrokerMetricSample(rec["broker"], rec["ts"], tuple(rec["values"]))
+                )
+            if len(psamples) + len(bsamples) >= self.REPLAY_CHUNK:
+                flush()
+        flush()
+        self.last_replay_skipped = counts["skipped"]
+        if counts["skipped"]:
+            REGISTRY.counter(SAMPLE_STORE_SKIPPED_COUNTER).inc(counts["skipped"])
         return total
 
     def close(self) -> None:
-        with self._lock:
-            if self._fh:
-                self._fh.close()
-                self._fh = None
+        self._journal.close()

@@ -183,6 +183,28 @@ def _gate_baseline() -> dict:
         return {}
 
 
+def _render_readiness(families: Dict[str, _Family], registry) -> None:
+    """First-class readiness/recovery metrics (the k8s-dashboard contract):
+    ``cruise_control_tpu_ready`` is THE signal a fleet scheduler keys on, so
+    it gets a stable dedicated name instead of hiding in the generic
+    family/sensor gauge mapping (which also carries these values)."""
+    from cruise_control_tpu.core.sensors import (
+        READY_GAUGE,
+        RECOVERY_RECORDS_GAUGE,
+        RECOVERY_WALL_GAUGE,
+    )
+
+    snap = registry.snapshot().get("gauges", {})
+    if READY_GAUGE in snap:
+        families[f"{PREFIX}_ready"].add({}, snap[READY_GAUGE])
+    if RECOVERY_WALL_GAUGE in snap:
+        families[f"{PREFIX}_recovery_wall_seconds"].add({}, snap[RECOVERY_WALL_GAUGE])
+    if RECOVERY_RECORDS_GAUGE in snap:
+        families[f"{PREFIX}_recovery_records_replayed"].add(
+            {}, snap[RECOVERY_RECORDS_GAUGE]
+        )
+
+
 def _render_gate(families: Dict[str, _Family]) -> None:
     fam = families[f"{PREFIX}_gate_baseline"]
     for tier, m in sorted(_gate_baseline().get("tiers", {}).items()):
@@ -232,6 +254,16 @@ _FAMILY_DEFS = {
     f"{PREFIX}_gate_baseline": (
         "gauge", "Committed regression-gate baseline numbers per tier"
     ),
+    f"{PREFIX}_ready": (
+        "gauge",
+        "1 once the startup ladder (recovering/monitor_warming) reached ready",
+    ),
+    f"{PREFIX}_recovery_wall_seconds": (
+        "gauge", "Wall seconds of the last startup journal-recovery pass"
+    ),
+    f"{PREFIX}_recovery_records_replayed": (
+        "gauge", "Journal records replayed by the last startup recovery pass"
+    ),
 }
 
 
@@ -264,6 +296,7 @@ def render_prometheus(registry=None, recorder=None, profiler=None) -> str:
     _render_sensors(families, registry)
     _render_recorder(families, recorder)
     _render_profiler(families, profiler)
+    _render_readiness(families, registry)
     _render_gate(families)
     out: List[str] = []
     for fam in families.values():

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Benchmark cold-restart-to-ready over a populated crash-recovery journal.
+
+Builds a deterministic journal workload — N finished executions (start +
+per-task transitions + finish), one interrupted execution with in-flight
+tasks, and M completed user tasks with embedded result bodies — then measures
+the recovery wall a restarted process pays before it can serve traffic:
+``Executor.recover()`` (journal replay + backend reconciliation) plus the
+``UserTaskManager`` journal replay.
+
+Regression gate (same pattern as ``obs/gate.py`` tiers): the measured wall is
+compared against the committed ``benchmarks/BENCH_RECOVERY_cpu.json``; a
+>25 % regression (after an absolute noise floor, × ``CC_TPU_GATE_WALL_SLACK``
+on shared runners) exits 1.  The workload sizes are pinned in this script, so
+a record-count mismatch vs the baseline is an infrastructure error (exit 2),
+not a regression.
+
+    python scripts/bench_recovery.py                     # run + gate
+    python scripts/bench_recovery.py --update-baseline   # regenerate baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal  # noqa: E402
+from cruise_control_tpu.api.usertasks import UserTaskManager  # noqa: E402
+from cruise_control_tpu.backend import FakeClusterBackend  # noqa: E402
+from cruise_control_tpu.core.journal import Journal  # noqa: E402
+from cruise_control_tpu.executor import ExecutionJournal, Executor  # noqa: E402
+from cruise_control_tpu.executor.engine import ExecutionSummary  # noqa: E402
+from cruise_control_tpu.executor.tasks import (  # noqa: E402
+    ExecutionTask,
+    TaskState,
+    TaskType,
+)
+
+SCHEMA = 1
+BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "BENCH_RECOVERY_cpu.json",
+)
+#: pinned workload (changing these requires --update-baseline).  The
+#: execution WAL compacts itself after every finished execution, so the
+#: replayed state is the interrupted execution plus the user-task retention
+#: window — which is why USER_TASKS carries the bulk of the record count
+EXECUTIONS = 50
+TASKS_PER_EXECUTION = 8
+USER_TASKS = 2000
+PARTITIONS = 64
+BROKERS = 8
+
+MAX_WALL_RATIO = 1.25
+WALL_FLOOR_S = 0.25
+
+
+def _backend() -> FakeClusterBackend:
+    b = FakeClusterBackend()
+    for i in range(BROKERS):
+        b.add_broker(i, rack=str(i % 2))
+    for p in range(PARTITIONS):
+        b.create_partition(("T", p), [p % BROKERS, (p + 1) % BROKERS],
+                           load=[1.0, 1e3, 1e3, 1e4])
+    return b
+
+
+def _prop(p: int) -> ExecutionProposal:
+    # replica action only (leader stays put): recovery of the interrupted
+    # execution then needs no leader-election calls, keeping the measurement
+    # about journal replay + reconciliation
+    lead = p % BROKERS
+    return ExecutionProposal(
+        tp=("T", p % PARTITIONS), partition_size=1.0, old_leader=lead,
+        old_replicas=(lead, (p + 1) % BROKERS),
+        new_replicas=(lead, (p + 2) % BROKERS),
+    )
+
+
+def populate(journal_dir: str) -> dict:
+    t0 = time.monotonic()
+    ej = ExecutionJournal(Journal(os.path.join(journal_dir, "executor")))
+    for e in range(1, EXECUTIONS + 1):
+        props = [_prop(e * TASKS_PER_EXECUTION + i) for i in range(TASKS_PER_EXECUTION)]
+        ej.execution_started(e, props)
+        for p in props:
+            t = ExecutionTask(p, TaskType.INTER_BROKER_REPLICA_ACTION)
+            t.state = TaskState.IN_PROGRESS
+            ej.task_transition(e, t)
+            t.state = TaskState.COMPLETED
+            ej.task_transition(e, t)
+        ej.execution_finished(
+            ExecutionSummary(
+                execution_id=e, stopped=False, completed=len(props),
+                dead=0, aborted=0, duration_s=0.1,
+            )
+        )
+    # the interrupted one: started, tasks IN_PROGRESS, no finished record
+    interrupted = EXECUTIONS + 1
+    props = [_prop(i) for i in range(TASKS_PER_EXECUTION)]
+    ej.execution_started(interrupted, props)
+    for p in props:
+        t = ExecutionTask(p, TaskType.INTER_BROKER_REPLICA_ACTION)
+        t.state = TaskState.IN_PROGRESS
+        ej.task_transition(interrupted, t)
+    ej.close()
+
+    uj = Journal(os.path.join(journal_dir, "usertasks"))
+    for i in range(USER_TASKS):
+        uj.append(
+            {
+                "type": "user_task_created", "task_id": f"task-{i}",
+                "endpoint": "REBALANCE",
+                "created_ms": int(time.time() * 1000), "parent_id": f"req-{i}",
+            }
+        )
+        uj.append(
+            {
+                "type": "user_task_finished", "task_id": f"task-{i}",
+                "status": "Completed", "ts_ms": int(time.time() * 1000),
+                "result": {"numProposals": i, "proposals": []},
+            }
+        )
+    uj.close()
+    return {"populate_s": round(time.monotonic() - t0, 3)}
+
+
+def measure(journal_dir: str) -> dict:
+    backend = _backend()
+    t0 = time.monotonic()
+    executor = Executor(
+        backend,
+        journal=ExecutionJournal(Journal(os.path.join(journal_dir, "executor"))),
+    )
+    recovered = executor.recover()
+    manager = UserTaskManager(journal=Journal(os.path.join(journal_dir, "usertasks")))
+    wall = time.monotonic() - t0
+    manager.shutdown()
+    records = executor.last_recovery_stats.records + manager.recovered_records
+    return {
+        "wall_s": round(wall, 4),
+        "records": records,
+        "executions_recovered": len(recovered),
+        "recovered_tasks": sum(s.total for s in recovered),
+        "user_tasks_recovered": manager.recovered_tasks,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="recovery runs; best wall is gated (scheduler noise)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    results = []
+    for _ in range(max(args.repeats, 1)):
+        tmp = tempfile.mkdtemp(prefix="cc-tpu-bench-recovery-")
+        try:
+            pop = populate(tmp)
+            m = measure(tmp)
+            m.update(pop)
+            results.append(m)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    best = min(results, key=lambda r: r["wall_s"])
+    doc = {
+        "schema": SCHEMA,
+        "workload": {
+            "executions": EXECUTIONS,
+            "tasks_per_execution": TASKS_PER_EXECUTION,
+            "user_tasks": USER_TASKS,
+        },
+        **best,
+    }
+    print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+
+    if args.update_baseline:
+        with open(BASELINE, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"baseline written: {BASELINE}", file=sys.stderr)
+        return 0
+
+    if not os.path.exists(BASELINE):
+        print(f"missing baseline {BASELINE}; run --update-baseline", file=sys.stderr)
+        return 2
+    with open(BASELINE) as f:
+        base = json.load(f)
+    if base.get("records") != doc["records"]:
+        print(
+            f"workload mismatch: baseline {base.get('records')} records vs "
+            f"measured {doc['records']} — regenerate the baseline",
+            file=sys.stderr,
+        )
+        return 2
+    if doc["executions_recovered"] != 1 or doc["user_tasks_recovered"] != USER_TASKS:
+        print("recovery self-check failed (wrong recovered counts)", file=sys.stderr)
+        return 2
+    slack = float(os.environ.get("CC_TPU_GATE_WALL_SLACK", "1.0"))
+    budget = base["wall_s"] * MAX_WALL_RATIO * slack + WALL_FLOOR_S
+    if doc["wall_s"] > budget:
+        print(
+            f"RECOVERY REGRESSION: wall {doc['wall_s']:.3f}s > budget "
+            f"{budget:.3f}s (baseline {base['wall_s']:.3f}s × {MAX_WALL_RATIO}"
+            f" × slack {slack} + {WALL_FLOOR_S}s floor)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"recovery gate OK: wall {doc['wall_s']:.3f}s <= budget {budget:.3f}s",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,12 @@ python scripts/bench_sim.py --repeats 1 >/dev/null
 echo "== metrics lint (boot app on fake backend, scrape /METRICS, strict exposition parse) =="
 python -m pytest tests/test_telemetry.py -q -k "metrics_lint or content_type"
 
+echo "== recovery tier (crash-safe journal, kill-and-restart, readiness gate) =="
+python -m pytest tests/test_recovery.py -x -q
+
+echo "== recovery bench (cold-restart-to-ready wall vs committed baseline) =="
+python scripts/bench_recovery.py >/dev/null
+
 echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check) =="
 python scripts/bench_gate.py
 

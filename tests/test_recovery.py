@@ -1,0 +1,816 @@
+"""Crash-safe recovery plane: journal, executor recovery, durable user tasks,
+readiness-gated startup.
+
+The acceptance scenario (ISSUE 6): with a chaos-stalled reassignment in
+flight, an ungraceful restart on the same journal dirs reconciles every
+journaled task (resumed or rolled back, exact accounting), re-serves the
+completed user task's result from USER_TASKS, and /healthz walks
+``recovering`` → ``ready``.  Plus the unit tiers underneath: WAL checksum/
+truncation/rotation semantics, FileSampleStore crash hardening, chaos
+crash-point faults, recovery reconcile paths, the optimize deadline, and the
+503-until-ready gate over real HTTP.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.backend import (
+    ChaosBackend,
+    FakeClusterBackend,
+    FaultPlan,
+    SimulatedCrash,
+)
+from cruise_control_tpu.core.journal import Journal
+from cruise_control_tpu.executor import ExecutionJournal, Executor
+from cruise_control_tpu.executor.tasks import ExecutionTask, TaskState, TaskType
+
+WINDOW_MS = 60_000
+
+
+def make_backend(latency=1, partitions=3, brokers=4):
+    b = FakeClusterBackend(reassignment_latency_polls=latency)
+    for i in range(brokers):
+        b.add_broker(i, rack=str(i % 2))
+    for p in range(partitions):
+        b.create_partition(
+            ("T", p), [p % 2, (p % 2 + 1) % brokers], load=[1.5, 4e3, 6e3, 3e4]
+        )
+    return b
+
+
+def prop(tp, old, new):
+    return ExecutionProposal(
+        tp=tp, partition_size=1.0, old_leader=old[0],
+        old_replicas=tuple(old), new_replicas=tuple(new),
+    )
+
+
+# -- the generic WAL ----------------------------------------------------------
+
+
+class TestJournal:
+    def test_round_trip_and_atomic_rotation(self, tmp_path):
+        j = Journal(str(tmp_path), max_segment_records=3)
+        for i in range(7):
+            j.append({"i": i})
+        names = sorted(os.listdir(tmp_path))
+        # two sealed segments (atomically renamed) + one active .open
+        assert names == [
+            "segment-000000.jsonl", "segment-000001.jsonl",
+            "segment-000002.jsonl.open",
+        ]
+        r = j.replay()
+        assert [x["i"] for x in r] == list(range(7))
+        assert r.skipped == 0 and r.segments == 3
+
+    def test_truncated_tail_tolerated(self, tmp_path):
+        j = Journal(str(tmp_path), max_segment_records=100)
+        for i in range(5):
+            j.append({"i": i})
+        p = tmp_path / "segment-000000.jsonl.open"
+        data = p.read_bytes()
+        p.write_bytes(data[:-7])   # crash mid-append: torn last line
+        r = j.replay()
+        assert [x["i"] for x in r] == [0, 1, 2, 3]
+        assert r.skipped == 1
+
+    def test_corrupt_line_prefix_semantics_per_segment(self, tmp_path):
+        j = Journal(str(tmp_path), max_segment_records=3)
+        for i in range(6):
+            j.append({"i": i})
+        j.close()
+        # garble a byte inside segment 0's second record's payload
+        p = tmp_path / "segment-000000.jsonl"
+        lines = p.read_text().splitlines()
+        lines[1] = lines[1].replace('"i":1', '"i":9')   # crc now mismatches
+        p.write_text("\n".join(lines) + "\n")
+        r = Journal(str(tmp_path)).replay()
+        # segment 0: valid prefix [0], rest skipped; segment 1 (sealed later,
+        # atomically) replays whole
+        assert [x["i"] for x in r] == [0, 3, 4, 5]
+        assert r.skipped == 2
+
+    def test_legacy_plain_jsonl_passthrough(self, tmp_path):
+        (tmp_path / "segment-000000.jsonl").write_text(
+            json.dumps({"kind": "legacy", "n": 1}) + "\n"
+        )
+        j = Journal(str(tmp_path))
+        r = j.replay()
+        assert r == [{"kind": "legacy", "n": 1}]
+        j.append({"kind": "new"})
+        r2 = j.replay()
+        assert [x["kind"] for x in r2] == ["legacy", "new"]
+
+    def test_reopen_seals_leftover_open_segment(self, tmp_path):
+        j = Journal(str(tmp_path), max_segment_records=100)
+        j.append({"i": 0})
+        # simulate a crash: no close(); a new writer on the same dir
+        j2 = Journal(str(tmp_path))
+        assert sorted(os.listdir(tmp_path)) == ["segment-000000.jsonl"]
+        j2.append({"i": 1})
+        assert [x["i"] for x in j2.replay()] == [0, 1]
+
+    def test_fsync_knob(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        j.append({"i": 0})
+        assert [x["i"] for x in j.replay()] == [0]
+        with pytest.raises(ValueError):
+            Journal(str(tmp_path), fsync="sometimes")
+
+    def test_crash_after_appends(self, tmp_path):
+        j = Journal(str(tmp_path))
+        j.crash_after_appends = 2
+        j.append({"i": 0})
+        j.append({"i": 1})
+        with pytest.raises(SimulatedCrash):
+            j.append({"i": 2})
+        # the crash point raises BEFORE writing: earlier records intact
+        assert [x["i"] for x in j.replay()] == [0, 1]
+
+
+# -- FileSampleStore hardening ------------------------------------------------
+
+
+class TestFileSampleStoreHardening:
+    def _batch(self, n=3, ts=1000):
+        from cruise_control_tpu.monitor.samples import (
+            BrokerMetricSample,
+            PartitionMetricSample,
+            SampleBatch,
+        )
+
+        return SampleBatch(
+            [
+                PartitionMetricSample(("T", i), i % 2, ts, (1.0, 2.0, 3.0, 4.0))
+                for i in range(n)
+            ],
+            [BrokerMetricSample(0, ts, tuple(float(i) for i in range(14)))],
+        )
+
+    def test_round_trip(self, tmp_path):
+        from cruise_control_tpu.monitor.samplestore import FileSampleStore
+
+        store = FileSampleStore(str(tmp_path))
+        store.store(self._batch())
+        store.close()
+        out = []
+        n = FileSampleStore(str(tmp_path)).replay(out.append)
+        assert n == 4
+        assert len(out[0].partition_samples) == 3
+        assert out[0].partition_samples[0].tp == ("T", 0)
+
+    def test_crash_truncated_segment_replays_prefix(self, tmp_path):
+        from cruise_control_tpu.monitor.samplestore import FileSampleStore
+
+        store = FileSampleStore(str(tmp_path))
+        store.store(self._batch(n=5))
+        # crash: truncate the active segment mid-record, no close()
+        p = tmp_path / "segment-000000.jsonl.open"
+        data = p.read_bytes()
+        p.write_bytes(data[: len(data) - 20])
+        store2 = FileSampleStore(str(tmp_path))
+        out = []
+        n = store2.replay(out.append)
+        assert n == 5   # 6 records written, torn tail dropped
+        assert store2.last_replay_skipped == 1
+
+    def test_legacy_plain_segment_replays(self, tmp_path):
+        from cruise_control_tpu.monitor.samplestore import FileSampleStore
+
+        rec = {"type": "partition", "topic": "T", "partition": 0, "broker": 1,
+               "ts": 5, "values": [1, 2, 3, 4]}
+        (tmp_path / "segment-000000.jsonl").write_text(json.dumps(rec) + "\n")
+        out = []
+        n = FileSampleStore(str(tmp_path)).replay(out.append)
+        assert n == 1 and out[0].partition_samples[0].broker_id == 1
+
+
+# -- chaos crash-point faults -------------------------------------------------
+
+
+class TestChaosCrashPoints:
+    def test_crash_after_is_deterministic_and_fatal(self):
+        plan = FaultPlan(seed=7).crash_after("describe_topics", 2)
+        chaos = ChaosBackend(make_backend(), plan)
+        chaos.describe_topics()
+        chaos.describe_topics()
+        with pytest.raises(SimulatedCrash):
+            chaos.describe_topics()
+        with pytest.raises(SimulatedCrash):   # a dead process stays dead
+            chaos.describe_topics()
+        assert [k for _, k, _ in chaos.fault_log] == ["crash", "crash"]
+
+    def test_crash_point_degrades_execution_with_exact_accounting(self):
+        # the executor's southbound call dies at a pinned call count; the
+        # retry policy must classify SimulatedCrash fatal (never replayed)
+        plan = FaultPlan(seed=7).crash_after("list_partition_reassignments", 1)
+        chaos = ChaosBackend(make_backend(latency=50), plan)
+        from cruise_control_tpu.core.retry import RetryPolicy
+
+        executor = Executor(chaos, retry_policy=RetryPolicy(max_attempts=3))
+        summary = executor.execute_proposals(
+            [prop(("T", 0), [0, 1], [2, 1]), prop(("T", 1), [1, 2], [1, 3])]
+        )
+        assert summary.error is not None and "SimulatedCrash" in summary.error
+        assert summary.total == summary.completed + summary.dead + summary.aborted + summary.failed
+        assert summary.failed >= 1   # in-flight at thread unwind
+        # fatal = exactly one crash raise, no retries of the dead call
+        assert chaos.calls["list_partition_reassignments"] == 2
+
+
+# -- execution-journal recovery (unit reconcile paths) ------------------------
+
+
+class TestExecutorRecovery:
+    def _journal(self, tmp_path, *proposals, execution_id=7):
+        j = ExecutionJournal(Journal(str(tmp_path)))
+        j.execution_started(execution_id, list(proposals))
+        return j
+
+    def _mark(self, j, execution_id, p, state, task_type=TaskType.INTER_BROKER_REPLICA_ACTION):
+        t = ExecutionTask(p, task_type)
+        t.state = state
+        j.task_transition(execution_id, t)
+
+    def test_in_progress_completed_while_down(self, tmp_path):
+        p1 = prop(("T", 0), [0, 1], [2, 1])
+        j = self._journal(tmp_path, p1)
+        self._mark(j, 7, p1, TaskState.IN_PROGRESS)
+        backend = make_backend()   # no ongoing reassignments: the move landed
+        ex = Executor(backend, journal=j)
+        s = ex.recover()[0]
+        assert s.execution_id == 7
+        assert s.completed == 1   # inter move finished while the process was down
+        assert s.completed + s.dead + s.aborted + s.failed == s.total
+        # exactly once through the drain queue (ExecutionFailureDetector feed)
+        assert len(ex.drain_degraded_summaries()) == 1
+        assert ex.drain_degraded_summaries() == []
+        assert ex.recover() == []   # finished record written: nothing left
+
+    def test_pending_never_launched_aborts(self, tmp_path):
+        p1 = prop(("T", 0), [0, 1], [2, 1])
+        j = self._journal(tmp_path, p1)   # no task record at all
+        ex = Executor(make_backend(), journal=j)
+        s = ex.recover()[0]
+        assert s.aborted == s.total   # recovery never launches new work
+
+    def test_pending_that_launched_is_adopted_and_resumed(self, tmp_path):
+        p1 = prop(("T", 0), [0, 1], [2, 1])
+        j = self._journal(tmp_path, p1)
+        backend = make_backend(latency=3)
+        # the alter landed before the crash but its IN_PROGRESS write did not
+        backend.alter_partition_reassignments({("T", 0): [2, 1]})
+        ex = Executor(backend, journal=j, progress_check_interval_s=0.01)
+        s = ex.recover()[0]
+        # adopted as in-flight and supervised to completion
+        assert s.completed >= 1 and s.dead == 0
+        replicas = {
+            i.tp: i.replicas
+            for infos in backend.describe_topics().values() for i in infos
+        }
+        assert replicas[("T", 0)] == (2, 1)
+
+    def test_stalled_in_flight_rolled_back(self, tmp_path):
+        p1 = prop(("T", 0), [0, 1], [2, 1])
+        j = self._journal(tmp_path, p1)
+        inner = make_backend()
+        chaos = ChaosBackend(inner, FaultPlan(seed=7).stall_reassignments())
+        chaos.alter_partition_reassignments({("T", 0): [2, 1]})
+        self._mark(j, 7, p1, TaskState.IN_PROGRESS)
+        ex = Executor(chaos, journal=j, rollback_stuck_tasks=True)
+        s = ex.recover()[0]
+        assert s.dead >= 1
+        assert not chaos.stalled_reassignments   # cancel cleared the stall
+        replicas = {
+            i.tp: i.replicas
+            for infos in inner.describe_topics().values() for i in infos
+        }
+        assert replicas[("T", 0)] == (0, 1)   # reverted to old_replicas
+
+    def test_stalled_in_flight_without_rollback_times_out_dead(self, tmp_path):
+        p1 = prop(("T", 0), [0, 1], [2, 1])
+        j = self._journal(tmp_path, p1)
+        chaos = ChaosBackend(make_backend(), FaultPlan(seed=7).stall_reassignments())
+        chaos.alter_partition_reassignments({("T", 0): [2, 1]})
+        self._mark(j, 7, p1, TaskState.IN_PROGRESS)
+        ex = Executor(
+            chaos, journal=j, rollback_stuck_tasks=False,
+            recovery_timeout_s=0.05, progress_check_interval_s=0.01,
+        )
+        s = ex.recover()[0]
+        assert s.dead >= 1
+        assert chaos.stalled_reassignments   # no cancel without the policy
+
+    def test_unreachable_backend_degrades_recovery_not_startup(self, tmp_path):
+        p1 = prop(("T", 0), [0, 1], [2, 1])
+        j = self._journal(tmp_path, p1)
+        self._mark(j, 7, p1, TaskState.IN_PROGRESS)
+        # backend dead from the first call: reconciliation cannot run
+        chaos = ChaosBackend(
+            make_backend(), FaultPlan(seed=7).crash_after("*", 0)
+        )
+        ex = Executor(chaos, journal=j)
+        summaries = ex.recover()   # must NOT raise out of startup
+        assert len(summaries) == 1
+        s = summaries[0]
+        assert "reconciliation failed" in s.error
+        assert s.failed >= 1   # unresolved tasks land in the failed bucket
+        assert s.completed + s.dead + s.aborted + s.failed == s.total
+        # no finished record was written: the next restart retries against
+        # a (now live) backend and fully reconciles
+        chaos.plan.crash_points.clear()
+        ex2 = Executor(make_backend(), journal=ExecutionJournal(Journal(str(tmp_path))))
+        s2 = ex2.recover()[0]
+        assert "recovered" in s2.error and s2.failed == 0
+        assert ex2.recover() == []
+
+    def test_execution_ids_continue_past_journal(self, tmp_path):
+        p1 = prop(("T", 0), [0, 1], [2, 1])
+        j = self._journal(tmp_path, p1, execution_id=41)
+        backend = make_backend()
+        ex = Executor(backend, journal=j)
+        ex.recover()
+        s = ex.execute_proposals([prop(("T", 1), [1, 2], [1, 3])])
+        assert s.execution_id > 41   # journaled ids are never reissued
+
+    def test_live_execution_journals_then_compacts(self, tmp_path):
+        j = ExecutionJournal(Journal(str(tmp_path)))
+        ex = Executor(make_backend(), journal=j)
+        s = ex.execute_proposals([prop(("T", 0), [0, 1], [2, 1])])
+        assert s.succeeded
+        # the WAL recorded the whole run (start + transitions + finished)...
+        assert j.journal.appends >= 4
+        # ...and compacted once the finished record landed: nothing in the
+        # journal is live state, so the next boot replays ~nothing
+        opens, stats = j.open_executions()
+        assert opens == [] and stats.records == 0
+        assert j.journal.replay() == []
+
+    def test_journal_write_failure_rejects_without_phantom_state(self, tmp_path):
+        j = ExecutionJournal(Journal(str(tmp_path)))
+        j.journal.crash_after_appends = 0   # every append refused
+        ex = Executor(make_backend(), journal=j)
+        with pytest.raises(SimulatedCrash):
+            ex.execute_proposals([prop(("T", 0), [0, 1], [2, 1])])
+        # the refused request left no stored state behind
+        assert ex.state == "NO_TASK_IN_PROGRESS"
+        assert ex._planner is None and not ex.has_ongoing_execution
+
+    def test_transition_reverts_when_journal_append_fails(self):
+        failures = []
+
+        def observer(task):
+            failures.append(task.state)
+            raise OSError("disk full")
+
+        t = ExecutionTask(
+            prop(("T", 0), [0, 1], [2, 1]), TaskType.INTER_BROKER_REPLICA_ACTION
+        )
+        t.observer = observer
+        with pytest.raises(OSError):
+            t.transition(TaskState.IN_PROGRESS, 123)
+        # memory and journal agree: the unjournalable transition did not happen
+        assert t.state is TaskState.PENDING and t.start_ms is None
+
+
+# -- durable user tasks -------------------------------------------------------
+
+
+class TestDurableUserTasks:
+    def test_completed_task_survives_restart_with_result(self, tmp_path):
+        from cruise_control_tpu.api.usertasks import TaskStatus, UserTaskManager
+
+        m1 = UserTaskManager(journal=Journal(str(tmp_path)))
+        task = m1.get_or_create(
+            "REBALANCE", ("k",), lambda p: {"answer": 42},
+            parent_id="req-1", result_to_json=lambda r: r,
+        )
+        task.future.result(timeout=10)
+        time.sleep(0.05)   # the finally-block journal write races the future
+        m1.shutdown()
+
+        m2 = UserTaskManager(journal=Journal(str(tmp_path)))
+        t2 = m2.get(task.task_id)
+        assert t2 is not None and t2.status is TaskStatus.COMPLETED
+        d = t2.to_dict()
+        assert d["result"] == {"answer": 42}
+        assert d["RequestId"] == "req-1"
+        m2.shutdown()
+
+    def test_in_flight_task_resurrects_as_interrupted(self, tmp_path):
+        from cruise_control_tpu.api.usertasks import TaskStatus, UserTaskManager
+
+        j = Journal(str(tmp_path))
+        j.append(
+            {
+                "type": "user_task_created", "task_id": "tid-1",
+                "endpoint": "REBALANCE",
+                "created_ms": int(time.time() * 1000), "parent_id": None,
+            }
+        )
+        j.close()
+        m = UserTaskManager(journal=Journal(str(tmp_path)))
+        t = m.get("tid-1")
+        assert t is not None
+        assert t.status is TaskStatus.COMPLETED_WITH_ERROR
+        assert "restart" in t.to_dict()["error"]
+        m.shutdown()
+
+    def test_refused_creation_write_leaves_no_zombie_task(self, tmp_path):
+        from cruise_control_tpu.api.usertasks import UserTaskManager
+
+        j = Journal(str(tmp_path))
+        m = UserTaskManager(journal=j)
+        j.crash_after_appends = j.appends   # every further append refused
+        with pytest.raises(SimulatedCrash):
+            m.get_or_create("REBALANCE", ("k",), lambda p: 1)
+        assert m.all_tasks() == []   # no wedged ACTIVE zombie pinned by dedupe
+        j.crash_after_appends = None
+        task = m.get_or_create("REBALANCE", ("k",), lambda p: 1)
+        assert task.future.result(timeout=10) == 1   # same key works again
+        m.shutdown()
+
+    def test_startup_compaction_bounds_the_journal(self, tmp_path):
+        from cruise_control_tpu.api.usertasks import TaskStatus, UserTaskManager
+
+        m1 = UserTaskManager(journal=Journal(str(tmp_path)))
+        done = m1.get_or_create(
+            "REBALANCE", ("a",), lambda p: {"n": 1},
+            result_to_json=lambda r: r,
+        )
+        done.future.result(timeout=10)
+        time.sleep(0.05)
+        # plus an in-flight record pair the crash never finished
+        m1._journal.append(
+            {"type": "user_task_created", "task_id": "tid-x",
+             "endpoint": "SIMULATE",
+             "created_ms": int(time.time() * 1000), "parent_id": None}
+        )
+        m1.shutdown()
+
+        m2 = UserTaskManager(journal=Journal(str(tmp_path)))
+        # compacted: exactly one created+finished pair per retained task,
+        # interrupted ones rewritten as finished-with-error
+        recs = m2._journal.replay()
+        assert len(recs) == 4
+        assert [r["type"] for r in recs] == [
+            "user_task_created", "user_task_finished",
+        ] * 2
+        m2.shutdown()
+        m3 = UserTaskManager(journal=Journal(str(tmp_path)))
+        assert m3.get(done.task_id).to_dict()["result"] == {"n": 1}
+        assert m3.get("tid-x").status is TaskStatus.COMPLETED_WITH_ERROR
+        m3.shutdown()
+
+    def test_failed_task_error_survives(self, tmp_path):
+        from cruise_control_tpu.api.usertasks import TaskStatus, UserTaskManager
+
+        def boom(p):
+            raise RuntimeError("kaput")
+
+        m1 = UserTaskManager(journal=Journal(str(tmp_path)))
+        task = m1.get_or_create("REBALANCE", ("k",), boom)
+        with pytest.raises(RuntimeError):
+            task.future.result(timeout=10)
+        time.sleep(0.05)
+        m1.shutdown()
+        m2 = UserTaskManager(journal=Journal(str(tmp_path)))
+        t2 = m2.get(task.task_id)
+        assert t2.status is TaskStatus.COMPLETED_WITH_ERROR
+        assert "kaput" in t2.error
+        m2.shutdown()
+
+
+# -- optimize deadline --------------------------------------------------------
+
+
+class TestOptimizeDeadline:
+    def _tiny(self):
+        from cruise_control_tpu.analyzer import GoalContext
+        from cruise_control_tpu.synthetic import SyntheticSpec, generate
+
+        state, _ = generate(
+            SyntheticSpec(
+                num_racks=2, num_brokers=3, num_topics=2, num_partitions=12,
+                replication_factor=2, seed=3,
+            )
+        )
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        return state, ctx
+
+    def test_expired_deadline_returns_degraded_best_so_far(self):
+        from cruise_control_tpu.analyzer import goals_base as G
+        from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+        from cruise_control_tpu.obs import RECORDER
+
+        gids = (G.RACK_AWARE, G.REPLICA_CAPACITY)
+        state, ctx = self._tiny()
+        final, result = GoalOptimizer(
+            goal_ids=gids, hard_ids=gids, deadline_s=0.0
+        ).optimize(state, ctx)
+        assert result.degraded is True
+        assert result.goal_reports == []        # no goal got to run
+        assert set(result.violations_after)     # violations still reported
+        assert final.num_brokers == state.num_brokers   # placement returned
+        trace = RECORDER.recent(limit=1, kind="optimize")[0]
+        assert trace.attrs["degraded"] is True
+
+    def test_roomy_deadline_not_degraded(self):
+        from cruise_control_tpu.analyzer import goals_base as G
+        from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+        gids = (G.RACK_AWARE, G.REPLICA_CAPACITY)
+        state, ctx = self._tiny()
+        _, result = GoalOptimizer(
+            goal_ids=gids, hard_ids=gids, deadline_s=3600.0
+        ).optimize(state, ctx)
+        assert result.degraded is False
+        assert len(result.goal_reports) == len(gids)
+
+    def test_degraded_surfaces_in_response_json(self):
+        from cruise_control_tpu.analyzer import goals_base as G
+        from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+        from cruise_control_tpu.api.server import _op_result_json
+        from cruise_control_tpu.facade import OperationResult
+
+        gids = (G.RACK_AWARE,)
+        state, ctx = self._tiny()
+        _, result = GoalOptimizer(
+            goal_ids=gids, hard_ids=gids, deadline_s=0.0
+        ).optimize(state, ctx)
+        body = _op_result_json(OperationResult(result, None, True))
+        assert body["degraded"] is True
+
+
+# -- readiness ladder (unit) --------------------------------------------------
+
+
+class TestReadinessController:
+    def test_ladder_and_lazy_monitor_probe(self):
+        from cruise_control_tpu.api.server import ReadinessController, ReadinessState
+
+        warm = {"ok": False}
+        rc = ReadinessController(monitor_probe=lambda: warm["ok"])
+        assert rc.phase == ReadinessState.STARTING and not rc.is_ready
+        rc.set_phase(ReadinessState.RECOVERING)
+        rc.set_phase(ReadinessState.MONITOR_WARMING)
+        assert rc.phase == ReadinessState.MONITOR_WARMING
+        warm["ok"] = True
+        assert rc.is_ready   # lazy edge on query
+        states = [s for s, _ in rc.history]
+        assert states == [
+            ReadinessState.STARTING, ReadinessState.RECOVERING,
+            ReadinessState.MONITOR_WARMING, ReadinessState.READY,
+        ]
+
+    def test_liveness_snapshot_never_touches_the_probe(self):
+        from cruise_control_tpu.api.server import ReadinessController, ReadinessState
+
+        calls = []
+
+        def probe():
+            calls.append(1)
+            return True
+
+        rc = ReadinessController(monitor_probe=probe)
+        rc.set_phase(ReadinessState.MONITOR_WARMING)
+        # liveness path: must answer from process state alone (a hung backend
+        # must not be able to hang the k8s livenessProbe)
+        snap = rc.snapshot(probe=False)
+        assert snap["state"] == ReadinessState.MONITOR_WARMING and calls == []
+        # readiness path probes and flips
+        assert rc.snapshot(probe=True)["ready"] and calls
+
+    def test_raising_probe_stays_unready(self):
+        from cruise_control_tpu.api.server import ReadinessController, ReadinessState
+
+        def boom():
+            raise RuntimeError("monitor down")
+
+        rc = ReadinessController(monitor_probe=boom)
+        rc.set_phase(ReadinessState.MONITOR_WARMING)
+        assert not rc.is_ready
+
+    def test_start_ready_for_embedded_construction(self):
+        from cruise_control_tpu.api.server import ReadinessController
+
+        assert ReadinessController(start_ready=True).is_ready
+
+
+# -- readiness gate + kill-and-restart over real HTTP -------------------------
+
+
+TRIMMED_GOALS = "RackAwareGoal,ReplicaCapacityGoal,ReplicaDistributionGoal"
+
+
+def app_props(tmp_path, journal=True):
+    props = {
+        "partition.metrics.window.ms": WINDOW_MS,
+        "num.partition.metrics.windows": 4,
+        "metric.sampling.interval.ms": 3_600_000,    # manual sampling only
+        "anomaly.detection.interval.ms": 3_600_000,  # detectors never fire
+        "broker.capacity.config.resolver.class":
+            "cruise_control_tpu.monitor.capacity.StaticCapacityResolver",
+        "sample.store.class":
+            "cruise_control_tpu.monitor.samplestore.FileSampleStore",
+        "sample.store.dir": str(tmp_path / "samples"),
+        "webserver.http.port": 0,
+        "min.valid.partition.ratio": 0.5,
+        # trimmed list: this module tests the recovery plane, not goal math
+        "default.goals": TRIMMED_GOALS,
+        "execution.task.rollback.on.timeout": True,
+        "recovery.timeout.ms": 2_000,
+    }
+    if journal:
+        props["journal.dir"] = str(tmp_path / "journal")
+    return props
+
+
+def make_app(tmp_path, backend, journal=True):
+    from cruise_control_tpu.app import CruiseControlTpuApp
+    from cruise_control_tpu.core.resources import Resource
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+
+    app = CruiseControlTpuApp(app_props(tmp_path, journal=journal), backend=backend)
+    app.monitor.capacity_resolver = StaticCapacityResolver(
+        {Resource.CPU: 100.0, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6,
+         Resource.DISK: 1e7}
+    )
+    return app
+
+
+def sample_windows(app, n=6):
+    now = int(time.time() * 1000)
+    for w in range(n):
+        app.monitor.sample_once(now_ms=now + w * WINDOW_MS)
+
+
+def poll_until(fn, timeout_s=30.0, interval_s=0.05, desc="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+class TestReadinessGateHTTP:
+    def test_503_until_monitor_warm(self, tmp_path):
+        import urllib.error
+        import urllib.request
+
+        from cruise_control_tpu.client import ClientError, CruiseControlClient
+
+        backend = make_backend(partitions=12)
+        app = make_app(tmp_path, backend, journal=False)
+        app.start(serve_http=True)   # NO samples: ladder parks at monitor_warming
+        try:
+            client = CruiseControlClient(f"http://127.0.0.1:{app.port}")
+            hz = client.healthz()
+            assert hz["status"] == "alive"          # liveness always answers
+            assert hz["state"] == "monitor_warming" and not hz["ready"]
+            # optimize-family POST refused with 503 + Retry-After
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{app.port}/kafkacruisecontrol/rebalance",
+                method="POST", data=b"",
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 503
+            assert exc.value.headers["Retry-After"]
+            assert json.loads(exc.value.read())["readiness"] == "monitor_warming"
+            # gated GET too (PROPOSALS runs the solver)
+            with pytest.raises(ClientError) as ce:
+                client.proposals()
+            assert ce.value.status == 503
+            # readinessProbe mode: 503 until ready
+            with pytest.raises(ClientError) as ce:
+                client.healthz(readiness=True)
+            assert ce.value.status == 503
+            # ungated surfaces keep answering while warming
+            assert "MonitorState" in client.state()
+            assert "cruise_control_tpu_ready 0" in client.metrics()
+            # warm the monitor -> lazy edge to ready
+            sample_windows(app)
+            hz2 = client.healthz(readiness=True)
+            assert hz2["ready"] and hz2["state"] == "ready"
+            assert "cruise_control_tpu_ready 1" in client.metrics()
+        finally:
+            app.stop()
+
+
+class TestKillAndRestart:
+    """The ISSUE-6 acceptance scenario, end to end over real HTTP."""
+
+    def test_ungraceful_restart_recovers_everything(self, tmp_path):
+        from cruise_control_tpu.client import CruiseControlClient
+
+        inner = make_backend(partitions=12)
+        plan = FaultPlan(seed=7).stall_reassignments()   # every reassignment stalls
+        chaos = ChaosBackend(inner, plan)
+
+        # ---- first life -----------------------------------------------------
+        app1 = make_app(tmp_path, chaos)
+        sample_windows(app1)   # persisted through the FileSampleStore
+        app1.start(serve_http=True)
+        c1 = CruiseControlClient(f"http://127.0.0.1:{app1.port}", poll_timeout_s=600.0)
+        # readiness mode probes (and flips) the warming edge; liveness mode
+        # deliberately never touches the backend
+        assert c1.healthz(readiness=True)["ready"]
+
+        # a completed user task whose result must survive the crash
+        dry = c1.rebalance(dryrun=True, request_id="req-recovery-dry")
+        assert dry["numProposals"] > 0
+        dry_task = [
+            t for t in c1.user_tasks()["userTasks"]
+            if t.get("RequestId") == "req-recovery-dry"
+        ]
+        assert dry_task and dry_task[0]["Status"] == "Completed"
+        dry_id = dry_task[0]["UserTaskId"]
+
+        # an executing rebalance pinned in flight by the chaos stall
+        c1.rebalance(dryrun=False, wait=False)
+        journal = app1.execution_journal.journal
+
+        def tasks_in_progress():
+            return chaos.stalled_reassignments and any(
+                r.get("type") == "task" and r.get("state") == "IN_PROGRESS"
+                for r in journal.replay()
+            )
+
+        poll_until(tasks_in_progress, desc="stalled tasks journaled IN_PROGRESS")
+
+        # ---- the crash: pin process death at exact points -------------------
+        # southbound calls die at the CURRENT call count; journal appends die
+        # immediately — exactly a process that stopped mid-progress-check,
+        # before any execution_finished record could land
+        plan.crash_after(
+            "list_partition_reassignments",
+            chaos.calls.get("list_partition_reassignments", 0),
+        )
+        journal.crash_after_appends = journal.appends
+        poll_until(
+            lambda: not app1.executor.has_ongoing_execution,
+            desc="execution thread death",
+        )
+        opens, _ = app1.execution_journal.open_executions()
+        assert len(opens) == 1   # interrupted execution visible in the WAL
+        # both user tasks (dry + execute) must have their completion records
+        # down before the "restart" — the status flip races the journal write
+        poll_until(
+            lambda: sum(
+                1 for r in app1.app.user_tasks._journal.replay()
+                if r.get("type") == "user_task_finished"
+            ) >= 2,
+            desc="user-task completion records journaled",
+        )
+        # app1 is now DROPPED: no app1.stop(), no journal close — the .open
+        # segments and the missing execution_finished record ARE the crash
+
+        # ---- second life: same dirs, same (still-degraded) cluster ----------
+        app2 = make_app(tmp_path, chaos)
+        app2.start(serve_http=True)
+        try:
+            c2 = CruiseControlClient(f"http://127.0.0.1:{app2.port}", poll_timeout_s=600.0)
+
+            # /healthz walked recovering -> ready (sample-store replay warmed
+            # the monitor, so the lazy edge fires on the first probe)
+            hz = c2.healthz(readiness=True)
+            states = [h["state"] for h in hz["history"]]
+            assert "recovering" in states
+            assert hz["ready"] and states[-1] == "ready"
+            assert hz["recovery"]["executions_recovered"] == 1
+            assert hz["recovery"]["records_replayed"] > 0
+
+            # exactly one recovered summary through the drain queue, with
+            # exact accounting over every journaled task
+            summaries = app2.executor.drain_degraded_summaries()
+            assert len(summaries) == 1
+            s = summaries[0]
+            assert s.total > 0
+            assert s.completed + s.dead + s.aborted + s.failed == s.total
+            assert s.failed == 0          # recovery resolves every task
+            assert s.dead >= 1            # the stalled moves were rolled back
+            assert "recovered" in s.error
+
+            # the rollback cancelled the stalled reassignments server-side
+            assert not chaos.stalled_reassignments
+            assert not inner.list_partition_reassignments()
+
+            # the completed user task answers the poll with its ORIGINAL body
+            survived = [
+                t for t in c2.user_tasks()["userTasks"]
+                if t["UserTaskId"] == dry_id
+            ]
+            assert survived and survived[0]["Status"] == "Completed"
+            assert survived[0]["result"]["numProposals"] == dry["numProposals"]
+
+            # and the recovered process serves optimize traffic again
+            again = c2.rebalance(dryrun=True)
+            assert again["numProposals"] >= 0 and not again["degraded"]
+        finally:
+            app2.stop()
