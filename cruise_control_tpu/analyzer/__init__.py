@@ -10,6 +10,7 @@ from cruise_control_tpu.analyzer.optimizer import (
     BatchedResult,
     GoalOptimizer,
     GoalReport,
+    IncrementalResult,
     MovementStats,
     OptimizationFailure,
     OptimizerResult,
@@ -22,6 +23,7 @@ __all__ = [
     "GoalContext",
     "GoalOptimizer",
     "GoalReport",
+    "IncrementalResult",
     "MovementStats",
     "OptimizationFailure",
     "OptimizerResult",

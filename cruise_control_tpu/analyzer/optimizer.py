@@ -329,6 +329,29 @@ class OptimizerResult:
 
 
 @dataclasses.dataclass
+class IncrementalResult:
+    """Outcome of one :meth:`GoalOptimizer.incremental_optimize` pass.
+
+    The continuous controller's tick result: only the goals violated in the
+    input state ran, each bounded to ``max_rounds`` rounds per phase, starting
+    from the CURRENT placement — never from scratch.  ``violations_before`` /
+    ``violations_after`` are full per-goal vectors (numpy, indexed by goal id)
+    so the caller can update its drift baseline without another dispatch."""
+
+    goals_run: List[str]
+    violations_before: "object"       # np.ndarray [NUM_GOALS]
+    violations_after: "object"        # np.ndarray [NUM_GOALS]
+    total_moves: int
+    total_rounds: int
+    num_dispatches: int
+    duration_s: float
+
+    @property
+    def residual_violations(self) -> float:
+        return float(self.violations_after.sum())
+
+
+@dataclasses.dataclass
 class BatchedResult:
     """Outcome of one :meth:`GoalOptimizer.batched_optimize` call.
 
@@ -785,6 +808,15 @@ class GoalOptimizer:
     @fuse_goal_dispatch.setter
     def fuse_goal_dispatch(self, value: bool) -> None:
         self._fuse_goal_dispatch = bool(value)
+
+    def violations(self, state: ClusterArrays, ctx: GoalContext):
+        """Per-goal violation counts for the configured goal list — ONE
+        compiled dispatch of the same ``_violations`` program every optimize
+        warms (the continuous controller's drift probe; the returned device
+        array can be fed straight into :meth:`incremental_optimize`)."""
+        return _violations(
+            state, ctx, enable_heavy=self.enable_heavy_goals, subset=self.goal_ids
+        )
 
     def optimize(
         self,
@@ -1351,3 +1383,133 @@ class GoalOptimizer:
             },
         )
         return final_np, batched
+
+    def warm_incremental_programs(
+        self, state: ClusterArrays, ctx: GoalContext, max_rounds: int
+    ) -> None:
+        """Pre-compile EVERY executable :meth:`incremental_optimize` can
+        touch for this shape: the violations probe, the NON-donating
+        ``_goal_step`` twin of every goal (the first violated goal of a tick
+        runs through it — and any goal can be first), and the donating chain
+        behind it (via one all-goals-violated pass over a throwaway copy).
+        The non-donating loop leaves ``state`` untouched (its outputs are
+        dropped); the donating pass consumes only the copy.  Idempotent and
+        ~free once the programs are cached."""
+        import numpy as np
+
+        jax.block_until_ready(self.violations(state, ctx))
+        heavy = self.enable_heavy_goals
+        prior: Tuple[int, ...] = ()
+        for gid in self.goal_ids:
+            if gid == G.KAFKA_ASSIGNER_RACK:
+                _assigner_step(
+                    state, ctx,
+                    max_rf=_max_replication_factor(state), enable_heavy=heavy,
+                )
+            else:
+                _goal_step(
+                    state, ctx,
+                    gid=gid, round_fns=GOAL_ROUNDS[gid],
+                    max_rounds=int(max_rounds), enable_heavy=heavy,
+                    prior_ids=prior, admit_ids=prior + (gid,),
+                )
+            prior = prior + (gid,)
+        scratch = jax.device_put(jax.device_get(state))
+        self.incremental_optimize(
+            scratch, ctx, max_rounds=max_rounds,
+            violations=np.ones(G.NUM_GOALS, np.float32),
+        )
+
+    def incremental_optimize(
+        self,
+        state: ClusterArrays,
+        ctx: GoalContext,
+        max_rounds: int,
+        violations=None,
+    ) -> Tuple[ClusterArrays, IncrementalResult]:
+        """Bounded re-optimize starting from the CURRENT placement — the
+        continuous controller's tick kernel (ROADMAP item 4: incremental
+        reconfiguration, never a from-scratch solve).
+
+        Only goals whose violation count in ``state`` is nonzero run, each as
+        ONE fused ``_goal_step`` dispatch with rounds capped at ``max_rounds``.
+        Crucially, every goal runs with its FULL-WALK prior prefix (every goal
+        before it in ``goal_ids``, run or skipped) as the static
+        ``prior_ids``/``admit_ids`` — so "later goals never violate earlier
+        ones" still holds against ALL earlier goals, and the static-argument
+        tuples exactly match a full :meth:`optimize` walk at the same
+        ``max_rounds``: after the first tick compiles them, every later tick
+        reuses the same executables (the 0-compile warm-tick contract the
+        controller bench gate enforces).
+
+        Differences from :meth:`optimize` (all deliberate for the tick path):
+        no broker-axis bucketing (the caller holds an already-bucketed warm
+        state), no offline pre-phases (dead-broker/disk repair is the anomaly
+        detectors' self-healing path, not load-drift correction), no proposal
+        diffing, no per-goal profiling, no trace of its own (the caller's
+        ``controller_tick`` trace owns the accounting).  ``violations``, when
+        given (the caller's drift-check fetch), saves the leading dispatch —
+        the budget is then ``len(goals_run) + 1``.
+
+        The first goal step consumes ``state`` through the non-donating jit
+        (the caller's warm pytree survives); every later step donates the
+        intermediate it owns, chaining buffers state-in/state-out.
+        """
+        import numpy as np
+
+        t0 = time.monotonic()
+        heavy = self.enable_heavy_goals
+        dispatches = 0
+        if violations is None:
+            viol0_np = np.asarray(
+                _violations(state, ctx, enable_heavy=heavy, subset=self.goal_ids)
+            )
+            dispatches += 1
+        else:
+            viol0_np = np.asarray(violations)
+
+        max_rounds = int(max_rounds)
+        drifted = {g for g in self.goal_ids if float(viol0_np[g]) > 0}
+        raw: List[tuple] = []
+        goals_run: List[str] = []
+        prior: Tuple[int, ...] = ()
+        first = True
+        for gid in self.goal_ids:
+            if gid in drifted:
+                if gid == G.KAFKA_ASSIGNER_RACK:
+                    step = _assigner_step if first else _assigner_step_don
+                    state, rounds, moves, before, after, _ = step(
+                        state, ctx,
+                        max_rf=_max_replication_factor(state),
+                        enable_heavy=heavy,
+                    )
+                else:
+                    step = _goal_step if first else _goal_step_don
+                    state, rounds, moves, before, after = step(
+                        state, ctx,
+                        gid=gid,
+                        round_fns=GOAL_ROUNDS[gid],
+                        max_rounds=max_rounds,
+                        enable_heavy=heavy,
+                        prior_ids=prior, admit_ids=prior + (gid,),
+                    )
+                first = False
+                dispatches += 1
+                raw.append((gid, rounds, moves))
+                goals_run.append(G.GOAL_NAMES[gid])
+            prior = prior + (gid,)
+
+        violN = _violations(state, ctx, enable_heavy=heavy, subset=self.goal_ids)
+        dispatches += 1
+        violN_np, fetched = jax.device_get(
+            (violN, [(r, m) for _, r, m in raw])
+        )
+        return state, IncrementalResult(
+            goals_run=goals_run,
+            violations_before=viol0_np,
+            violations_after=np.asarray(violN_np),
+            total_moves=int(sum(int(m) for _, m in fetched)),
+            total_rounds=int(sum(int(r) for r, _ in fetched)),
+            num_dispatches=dispatches,
+            duration_s=time.monotonic() - t0,
+        )

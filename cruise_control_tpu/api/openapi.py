@@ -50,7 +50,14 @@ _ASYNC_PARAMS = [
      "description": "approved two-step-verification request to execute"},
 ]
 
-#: endpoint-specific query parameters beyond the common/async sets
+#: POSTs that answer synchronously in the handler thread — no user task, no
+#: 202, no async params (CONTROLLER pause/resume/tick is a switch on the
+#: control loop, never a long-running operation)
+_SYNC_POST_ENDPOINTS = {"CONTROLLER"}
+
+#: endpoint-specific query parameters beyond the common/async sets.  A param
+#: carrying a ``"methods"`` key is emitted only for those methods (needed by
+#: dual-method endpoints whose POST switch params mean nothing on GET).
 _ENDPOINT_PARAMS = {
     "SIMULATE": [
         {"name": "scenarios", "in": "query", "required": False,
@@ -93,6 +100,19 @@ _ENDPOINT_PARAMS = {
                          "startup ladder recovering -> monitor_warming -> "
                          "ready completes; default liveness mode always "
                          "answers 200 with the ladder state in the body")},
+    ],
+    "CONTROLLER": [
+        {"name": "action", "in": "query", "required": False,
+         "schema": {"type": "string", "enum": ["pause", "resume", "tick"]},
+         "description": ("pause/resume the continuous control loop, or "
+                         "force one synchronous tick (GET returns the "
+                         "status: drift, staleness, standing proposal set, "
+                         "reaction-latency p50/p95)"),
+         "methods": ["post"]},
+        {"name": "reason", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": "operator note recorded with pause/resume",
+         "methods": ["post"]},
     ],
     "TRACES": [
         {"name": "kind", "in": "query", "required": False,
@@ -159,7 +179,10 @@ def generate_openapi() -> Dict[str, Any]:
     """The OpenAPI 3.0 document for the live REST surface."""
     paths: Dict[str, Any] = {}
     for name in sorted(GET_ENDPOINTS | POST_ENDPOINTS):
-        method = "get" if name in GET_ENDPOINTS else "post"
+        # an endpoint can serve both methods (CONTROLLER: GET status, POST
+        # pause/resume/tick) — emit one operation per registered method
+        methods = [m for m, reg in (("get", GET_ENDPOINTS), ("post", POST_ENDPOINTS))
+                   if name in reg]
         body_schema = RESPONSE_SCHEMAS.get(name)
         if name in _TEXT_ENDPOINTS:
             content = {
@@ -178,32 +201,39 @@ def generate_openapi() -> Dict[str, Any]:
                     else {"type": "object"}
                 }
             }
-        responses: Dict[str, Any] = {
-            "200": {"description": "success", "content": content}
-        }
-        params = list(_COMMON_PARAMS)
-        if method == "post":
-            responses["202"] = {
-                "description": (
-                    "accepted — async operation in progress; poll with the "
-                    "returned User-Task-ID header/userTaskId field"
-                ),
-                "content": {"application/json": {"schema": {"type": "object"}}},
+        ops: Dict[str, Any] = {}
+        for method in methods:
+            responses: Dict[str, Any] = {
+                "200": {"description": "success", "content": content}
             }
-            params = params + _ASYNC_PARAMS
-            if name in REVIEWABLE:
-                responses["202"]["description"] += (
-                    "; may instead return a pending review entry when "
-                    "two-step verification is enabled"
-                )
-        params = params + _ENDPOINT_PARAMS.get(name, [])
-        op = {
-            "operationId": name.lower(),
-            "summary": name,
-            "parameters": params,
-            "responses": responses,
-        }
-        paths[API_PREFIX + name.lower()] = {method: op}
+            params = list(_COMMON_PARAMS)
+            if method == "post" and name not in _SYNC_POST_ENDPOINTS:
+                responses["202"] = {
+                    "description": (
+                        "accepted — async operation in progress; poll with the "
+                        "returned User-Task-ID header/userTaskId field"
+                    ),
+                    "content": {"application/json": {"schema": {"type": "object"}}},
+                }
+                params = params + _ASYNC_PARAMS
+                if name in REVIEWABLE:
+                    responses["202"]["description"] += (
+                        "; may instead return a pending review entry when "
+                        "two-step verification is enabled"
+                    )
+            params = params + [
+                {k: v for k, v in p.items() if k != "methods"}
+                for p in _ENDPOINT_PARAMS.get(name, [])
+                if method in p.get("methods", ("get", "post"))
+            ]
+            op_id = name.lower() if len(methods) == 1 else f"{method}_{name.lower()}"
+            ops[method] = {
+                "operationId": op_id,
+                "summary": name,
+                "parameters": params,
+                "responses": responses,
+            }
+        paths[API_PREFIX + name.lower()] = ops
 
     return {
         "openapi": "3.0.3",
@@ -228,7 +258,43 @@ def write_yaml(path: str) -> None:
         yaml.safe_dump(generate_openapi(), f, sort_keys=False)
 
 
+def check_yaml(path: str) -> int:
+    """Drift check (CI): regenerate and diff against the committed copy.
+
+    The committed ``docs/openapi.yaml`` is generated, but nothing used to
+    refuse a stale commit — an endpoint added to the server silently left
+    the published contract behind.  Returns 0 when identical, 1 with a
+    unified diff on stderr when stale."""
+    import difflib
+    import sys
+
+    import yaml
+
+    want = yaml.safe_dump(generate_openapi(), sort_keys=False)
+    try:
+        with open(path) as f:
+            have = f.read()
+    except OSError:
+        have = ""
+    if want == have:
+        return 0
+    sys.stderr.write(
+        f"{path} is stale — regenerate with: "
+        f"python -m cruise_control_tpu.api.openapi {path}\n"
+    )
+    sys.stderr.writelines(
+        difflib.unified_diff(
+            have.splitlines(True), want.splitlines(True),
+            fromfile=path, tofile="generated",
+        )
+    )
+    return 1
+
+
 if __name__ == "__main__":
     import sys
 
+    if "--check" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--check"]
+        sys.exit(check_yaml(args[0] if args else "docs/openapi.yaml"))
     write_yaml(sys.argv[1] if len(sys.argv) > 1 else "docs/openapi.yaml")

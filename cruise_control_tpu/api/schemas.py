@@ -110,6 +110,36 @@ _USER_TASK = {
     "?error": str,
 }
 
+_CONTROLLER_STATUS = {
+    "enabled": bool,
+    #: fields below only when the controller is configured
+    "?state": str,                   # running | paused | warming
+    "?paused": bool,
+    "?pauseReason": (str, None),
+    "?warmed": bool,
+    "?stalenessS": float,
+    "?stale": bool,
+    "?drift": float,
+    "?balancedness": (float, None),
+    "?violatedGoals": [str],
+    "?standing": (
+        {
+            "version": int,
+            "createdMs": int,
+            "trigger": str,
+            "drift": float,
+            "numProposals": int,
+            "reactionS": (float, None),
+        },
+        None,
+    ),
+    "?reaction": {"p50S": float, "p95S": float, "count": int},
+    "?lastTick": (dict, None),
+    "?topology": dict,
+    "?config": dict,
+    "?action": str,                  # echoed by POST
+}
+
 _READINESS = {
     "state": str,
     "ready": bool,
@@ -130,8 +160,10 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
             "memory": [dict],
         },
         "?Readiness": _READINESS,
+        "?Controller": dict,
     },
     "HEALTHZ": {"status": str, **_READINESS},
+    "CONTROLLER": _CONTROLLER_STATUS,
     "LOAD": {"brokers": [_BROKER_LOAD], "?hosts": [dict]},
     "PARTITION_LOAD": {"records": [dict], "?resource": str},
     "PROPOSALS": {

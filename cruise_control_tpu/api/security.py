@@ -34,7 +34,11 @@ VIEWER_ENDPOINTS = {
     "STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE",
     "METRICS",
 }
-USER_ENDPOINTS = VIEWER_ENDPOINTS | {"USER_TASKS", "REVIEW_BOARD", "PERMISSIONS"}
+#: CONTROLLER status (GET) is USER-tier operational data; the POST switch
+#: stays ADMIN through the method rule below
+USER_ENDPOINTS = VIEWER_ENDPOINTS | {
+    "USER_TASKS", "REVIEW_BOARD", "PERMISSIONS", "CONTROLLER",
+}
 
 
 def required_role(endpoint: str, method: str) -> Role:

@@ -53,7 +53,7 @@ API_PREFIX = "/kafkacruisecontrol/"
 GET_ENDPOINTS = {
     "STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE",
     "USER_TASKS", "REVIEW_BOARD", "PERMISSIONS", "BOOTSTRAP", "TRAIN",
-    "TRACES", "METRICS", "HEALTHZ",
+    "TRACES", "METRICS", "HEALTHZ", "CONTROLLER",
 }
 #: endpoints whose 200 body is plain text, not JSON (Prometheus exposition)
 TEXT_ENDPOINTS = {"METRICS"}
@@ -61,11 +61,13 @@ POST_ENDPOINTS = {
     "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
     "FIX_OFFLINE_REPLICAS", "STOP_PROPOSAL_EXECUTION", "PAUSE_SAMPLING",
     "RESUME_SAMPLING", "TOPIC_CONFIGURATION", "RIGHTSIZE", "REMOVE_DISKS",
-    "ADMIN", "REVIEW", "SIMULATE",
+    "ADMIN", "REVIEW", "SIMULATE", "CONTROLLER",
 }
 #: POSTs that change cluster state and thus go through two-step verification
-#: (SIMULATE is a pure what-if evaluation — nothing to review)
-REVIEWABLE = POST_ENDPOINTS - {"REVIEW", "SIMULATE"}
+#: (SIMULATE is a pure what-if evaluation — nothing to review; CONTROLLER
+#: pause/resume flips the control loop, never the cluster — parking it in
+#: the purgatory would leave the loop unpausable during an incident)
+REVIEWABLE = POST_ENDPOINTS - {"REVIEW", "SIMULATE", "CONTROLLER"}
 #: optimize-family endpoints: anything that would build a cluster model and
 #: run the solver is refused with 503 + Retry-After until the process is
 #: ready (journal recovery finished, monitor windows warm) — the k8s-probe
@@ -250,10 +252,14 @@ class CruiseControlApp:
         proposal_cache_ttl_s: float = 900.0,   # proposal.expiration.ms default
         readiness: Optional[ReadinessController] = None,
         user_task_journal=None,
+        controller=None,
     ) -> None:
         self.cc = cruise_control
         self.anomaly_manager = anomaly_manager
         self.provisioner = provisioner
+        #: the continuous control loop (controller/loop.py), None unless
+        #: controller.enable — serves the CONTROLLER endpoint + STATE block
+        self.controller = controller
         self.security = security or NoSecurityProvider()
         self.two_step = two_step_verification
         # embedded/test construction defaults to always-ready; the app shell
@@ -321,6 +327,9 @@ class CruiseControlApp:
         body["Profiler"] = PROFILER.snapshot()
         # readiness ladder + recovery accounting (journal replay, wall)
         body["Readiness"] = self.readiness.snapshot()
+        # continuous control loop: drift, standing set, reaction latency
+        if self.controller is not None:
+            body["Controller"] = self.controller.status()
         return 200, body
 
     def get_healthz(self, params) -> Tuple[int, dict]:
@@ -482,6 +491,15 @@ class CruiseControlApp:
         from cruise_control_tpu.obs.exporter import render_prometheus
 
         return 200, render_prometheus()
+
+    def get_controller(self, params) -> Tuple[int, dict]:
+        """Continuous-controller status: drift, staleness, the standing
+        proposal set's version/size, reaction-latency p50/p95.  Answers
+        ``{"enabled": false}`` when the loop is not configured
+        (``controller.enable``)."""
+        if self.controller is None:
+            return 200, {"enabled": False}
+        return 200, {"enabled": True, **self.controller.status()}
 
     def get_train(self, params) -> Tuple[int, dict]:
         start = int(params.get("start", ["0"])[0])
@@ -675,6 +693,25 @@ class CruiseControlApp:
             return self.cc.remove_disks(pairs, dryrun=dryrun)
 
         return self._async_op("REMOVE_DISKS", params, work)
+
+    def post_controller(self, params):
+        """Operator switch on the control loop: ``action=pause`` /
+        ``resume`` (with optional ``reason``) or ``tick`` (force one
+        synchronous control-loop evaluation — ops escape hatch when waiting
+        for drift/cadence is the wrong answer)."""
+        if self.controller is None:
+            return 400, {"error": "no controller configured (controller.enable)"}, {}
+        action = params.get("action", [None])[0]
+        reason = params.get("reason", ["operator request"])[0]
+        if action == "pause":
+            self.controller.pause(reason)
+        elif action == "resume":
+            self.controller.resume(reason)
+        elif action == "tick":
+            self.controller.maybe_tick(force=True)
+        else:
+            return 400, {"error": f"action must be pause|resume|tick, got {action!r}"}, {}
+        return 200, {"enabled": True, "action": action, **self.controller.status()}, {}
 
     def post_admin(self, params):
         changed = {}

@@ -261,6 +261,48 @@ class CruiseControlTpuApp:
             optimize_deadline_s=(deadline_ms / 1000.0) if deadline_ms else None,
         )
 
+        # readiness ladder: monitor_warming → ready flips once the window
+        # ring holds at least one valid window (the weakest completeness any
+        # model consumer needs) — evaluated lazily on probe, no poll thread.
+        # Built BEFORE the detector manager: its probe gates the detectors'
+        # immediate first pass
+        def _monitor_warm() -> bool:
+            try:
+                return self.monitor.state().num_valid_windows >= 1
+            except Exception:
+                return False
+
+        self.readiness = ReadinessController(monitor_probe=_monitor_warm)
+
+        # continuous control loop (controller.enable): streaming drift-
+        # triggered incremental rebalancing with a durable standing proposal
+        # set (journal.dir namespace <dir>/controller)
+        self.controller = None
+        if cfg.get("controller.enable"):
+            from cruise_control_tpu.controller import (
+                ContinuousController,
+                ControllerConfig,
+                ControllerJournal,
+            )
+
+            controller_journal = None
+            if jdir:
+                controller_journal = ControllerJournal(
+                    Journal(os.path.join(jdir, "controller"), **jkw)
+                )
+            self.controller = ContinuousController(
+                self.cruise_control,
+                journal=controller_journal,
+                config=ControllerConfig(
+                    tick_interval_s=cfg.get("controller.tick.interval.ms") / 1000.0,
+                    drift_threshold=cfg.get("controller.drift.threshold"),
+                    max_rounds_per_tick=cfg.get("controller.max.rounds.per.tick"),
+                    stale_after_s=cfg.get("controller.stale.after.ms") / 1000.0,
+                    execute=cfg.get("controller.execute.enable"),
+                ),
+            )
+            self.monitor.add_window_listener(self.controller.on_window_delta)
+
         interval = cfg.get("anomaly.detection.interval.ms") / 1000.0
 
         def _iv(key):
@@ -319,18 +361,13 @@ class CruiseControlTpuApp:
             for t in list(notifier._enabled):
                 notifier._enabled[t] = False
         self.anomaly_manager = AnomalyDetectorManager(
-            self.cruise_control, notifier, detectors
+            self.cruise_control, notifier, detectors,
+            # one immediate pass per detector once the readiness ladder
+            # reaches ready (anomaly.detection.initial.pass) — without it the
+            # first detection waits a full interval after every restart
+            initial_pass=cfg.get("anomaly.detection.initial.pass"),
+            ready_probe=lambda: self.readiness.is_ready,
         )
-        # readiness ladder: monitor_warming → ready flips once the window
-        # ring holds at least one valid window (the weakest completeness any
-        # model consumer needs) — evaluated lazily on probe, no poll thread
-        def _monitor_warm() -> bool:
-            try:
-                return self.monitor.state().num_valid_windows >= 1
-            except Exception:
-                return False
-
-        self.readiness = ReadinessController(monitor_probe=_monitor_warm)
         self.app = CruiseControlApp(
             self.cruise_control,
             anomaly_manager=self.anomaly_manager,
@@ -340,6 +377,7 @@ class CruiseControlTpuApp:
             proposal_cache_ttl_s=cfg.get("proposal.expiration.ms") / 1000.0,
             readiness=self.readiness,
             user_task_journal=self._user_task_journal,
+            controller=self.controller,
         )
         self._server = None
         self._sampling_thread: Optional[threading.Thread] = None
@@ -384,9 +422,22 @@ class CruiseControlTpuApp:
                 recovered = self.executor.recover()
             except Exception as e:
                 recovery_error = f"{type(e).__name__}: {e}"
+        controller_records = 0
+        if self.controller is not None:
+            # the standing proposal set rides the same recovery phase: a
+            # crashed controller resumes its journaled set, not a cold loop
+            try:
+                controller_records = self.controller.recover()
+            except Exception as e:
+                if recovery_error is None:
+                    recovery_error = f"{type(e).__name__}: {e}"
         wall = time.monotonic() - t_rec
         stats = self.executor.last_recovery_stats
-        records = (stats.records if stats else 0) + self.app.user_tasks.recovered_records
+        records = (
+            (stats.records if stats else 0)
+            + self.app.user_tasks.recovered_records
+            + controller_records
+        )
         REGISTRY.gauge(RECOVERY_RECORDS_GAUGE).set(records)
         REGISTRY.gauge(RECOVERY_WALL_GAUGE).set(wall)
         self.readiness.recovery = {
@@ -421,10 +472,16 @@ class CruiseControlTpuApp:
 
         self._sampling_thread = threading.Thread(target=_sampling_loop, daemon=True)
         self._sampling_thread.start()
+        if self.controller is not None:
+            # the loop thread wakes on window deltas (and on cadence); it
+            # warm-starts itself lazily once the monitor has a stable window
+            self.controller.start()
         self.app.start_proposal_refresher()
 
     def stop(self) -> None:
         self._stop.set()
+        if self.controller is not None:
+            self.controller.stop()   # seals the controller journal
         self.app.stop_proposal_refresher()
         if self._server is not None:
             self._server.shutdown()

@@ -45,6 +45,16 @@ def build_parser() -> argparse.ArgumentParser:
     hz.add_argument("--readiness", action="store_true",
                     help="probe mode: exit 1 (HTTP 503) until the server is ready")
 
+    ct = sub.add_parser(
+        "controller",
+        help="continuous control loop: status (default), pause, resume, or "
+             "force one tick (GET/POST /controller)",
+    )
+    ct.add_argument("action", nargs="?", default="status",
+                    choices=["status", "pause", "resume", "tick"])
+    ct.add_argument("--reason", default="cctpu",
+                    help="operator note recorded with pause/resume")
+
     tr = sub.add_parser(
         "traces", help="flight-recorder records, filterable by correlation id"
     )
@@ -136,6 +146,15 @@ def main(argv=None) -> int:
             return 0
         elif ep == "health":
             out = client.healthz(readiness=args.readiness)
+        elif ep == "controller":
+            if args.action == "status":
+                out = client.controller_status()
+            elif args.action == "pause":
+                out = client.controller_pause(reason=args.reason)
+            elif args.action == "resume":
+                out = client.controller_resume(reason=args.reason)
+            else:
+                out = client.controller_tick()
         elif ep == "traces":
             out = client.traces(kind=args.kind, trace_id=args.trace_id,
                                 parent_id=args.parent_id, limit=args.limit)

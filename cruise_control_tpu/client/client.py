@@ -189,6 +189,25 @@ class CruiseControlClient:
             raise ClientError(status, body)
         return body
 
+    def controller_status(self) -> Any:
+        """GET /controller: the continuous control loop's status — drift,
+        staleness, standing proposal set, reaction-latency p50/p95.
+        ``{"enabled": false}`` when ``controller.enable`` is off."""
+        return self._get("controller")
+
+    def controller_pause(self, reason: str = "client request") -> Any:
+        """POST /controller?action=pause: stop the loop from ticking (the
+        standing set keeps standing)."""
+        return self._post("controller", action="pause", reason=reason)
+
+    def controller_resume(self, reason: str = "client request") -> Any:
+        return self._post("controller", action="resume", reason=reason)
+
+    def controller_tick(self) -> Any:
+        """POST /controller?action=tick: force one synchronous control-loop
+        evaluation instead of waiting for drift/cadence."""
+        return self._post("controller", action="tick")
+
     def healthz(self, readiness: bool = False) -> Any:
         """GET /healthz: liveness + the startup readiness ladder
         (``recovering`` → ``monitor_warming`` → ``ready``).  With

@@ -1,0 +1,22 @@
+"""Continuous control loop: streaming, drift-triggered incremental
+rebalancing with a durable standing proposal set.
+
+See :mod:`cruise_control_tpu.controller.loop` for the architecture notes
+(ROADMAP item 4: from request-driven solves to a continuous controller).
+"""
+
+from cruise_control_tpu.controller.loop import (
+    ContinuousController,
+    ControllerConfig,
+)
+from cruise_control_tpu.controller.standing import (
+    ControllerJournal,
+    StandingProposalSet,
+)
+
+__all__ = [
+    "ContinuousController",
+    "ControllerConfig",
+    "ControllerJournal",
+    "StandingProposalSet",
+]

@@ -1,0 +1,90 @@
+"""Drift detection: is the live cluster far enough from the last solve?
+
+"Integrative Dynamic Reconfiguration" (arxiv 1602.03770) gates incremental
+reconfiguration on a cheap continuously-evaluated divergence measure.  Here
+the measure is the per-goal violation vector — already a single compiled
+``_violations`` dispatch (the same program every optimize warms), fetched to
+host as one scalar vector per check; this module is the pure host-side math
+over that fetch.
+
+The baseline is the last solve's OUTPUT residual (and the probe state is that
+solve's output placement under live loads — the *candidate*; see loop.py):
+violations the bounded solve could not fix stay in the baseline, so an
+unsolvable tail or a published-but-undrained standing set never re-triggers
+ticks — only NEW load evidence (violations rising above what the last tick's
+answer left behind) counts as drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.optimizer import (
+    MAX_BALANCEDNESS_SCORE,
+    balancedness_cost_by_goal,
+)
+
+
+@dataclasses.dataclass
+class DriftReport:
+    """One drift evaluation (host math over a fetched violation vector)."""
+
+    #: Σ max(0, violations_now − violations_at_last_solve) over the goal list
+    #: — the threshold-gated score (``controller.drift.threshold``)
+    score: float
+    #: the hard-goal share of ``score`` (a hard-goal drift of any size is
+    #: urgent; surfaced so operators can alert on it separately)
+    hard_score: float
+    #: goals violated NOW (drifted or still standing) — the tick's work list
+    violated_goal_ids: Tuple[int, ...]
+    violated_goals: List[str]
+    #: weighted balancedness of the current state ∈ [0, 100]
+    balancedness: float
+    #: balancedness at the last solve minus now (positive = got worse)
+    balancedness_drop: float
+
+
+def evaluate_drift(
+    viol_now,
+    viol_at_solve,
+    goal_ids: Sequence[int],
+    hard_ids: Sequence[int],
+) -> DriftReport:
+    """Pure host math: no dispatches, no compiles (the vectors are fetched)."""
+    hard = set(hard_ids)
+    score = 0.0
+    hard_score = 0.0
+    violated: List[int] = []
+    for g in goal_ids:
+        now = float(viol_now[g])
+        base = float(viol_at_solve[g]) if viol_at_solve is not None else 0.0
+        d = max(0.0, now - base)
+        score += d
+        if g in hard:
+            hard_score += d
+        if now > 0:
+            violated.append(g)
+
+    costs = balancedness_cost_by_goal(list(goal_ids), hard)
+
+    def _balancedness(viol) -> float:
+        if viol is None:
+            return MAX_BALANCEDNESS_SCORE
+        s = MAX_BALANCEDNESS_SCORE
+        for g in goal_ids:
+            if float(viol[g]) > 0:
+                s -= costs[g]
+        return s
+
+    bal_now = _balancedness(viol_now)
+    bal_then = _balancedness(viol_at_solve)
+    return DriftReport(
+        score=score,
+        hard_score=hard_score,
+        violated_goal_ids=tuple(violated),
+        violated_goals=[G.GOAL_NAMES[g] for g in violated],
+        balancedness=bal_now,
+        balancedness_drop=bal_then - bal_now,
+    )

@@ -1,0 +1,789 @@
+"""ContinuousController: the streaming, drift-triggered control loop.
+
+ROADMAP item 4 / "Integrative Dynamic Reconfiguration" (arxiv 1602.03770):
+instead of re-solving from scratch per request/anomaly, the controller
+*continuously* tracks load and emits incremental reconfigurations:
+
+* **Warm device-resident state.**  One cluster model is built at warm-start
+  (padded to the broker-bucket ladder so every tick hits the same compiled
+  executables); after that, metric-window deltas pushed by the monitor's
+  window-completion listener refresh ONLY the load leaves (``base_load`` /
+  ``leadership_delta``) of the device-resident :class:`ClusterArrays` —
+  placement leaves are never rebuilt, so a tick pays zero model-construction
+  work and zero recompiles.
+
+* **Drift-gated ticks.**  Each wake runs one compiled violation dispatch (the
+  same ``_violations`` program every optimize warms) and host-side drift math
+  (:mod:`cruise_control_tpu.controller.drift`).  A tick's bounded incremental
+  re-optimize (``GoalOptimizer.incremental_optimize``: drifted goals only,
+  rounds capped by ``controller.max.rounds.per.tick``, donated state-in/
+  state-out chaining) runs when drift crosses ``controller.drift.threshold``
+  or the ``controller.tick.interval.ms`` cadence elapses with violations
+  outstanding — never from scratch, always from the current placement.
+
+* **Durable standing proposal set.**  Each productive tick publishes a
+  versioned :class:`StandingProposalSet` journaled write-ahead through the
+  PR-6 WAL (own ``journal.dir`` namespace); superseded versions are
+  invalidated, the executor drains the set under the existing policy knobs
+  (``controller.execute.enable``), and :meth:`recover` resumes the journaled
+  set after a crash instead of cold-starting the loop.
+
+The headline metric (arxiv 2402.06085's multi-objective framing) is
+**reaction latency** — wall time from a load-shift window delta landing to
+the corrective proposal set being published — exported as p50/p95 through the
+``Controller.reaction-latency-timer`` sensor on ``/metrics`` and gated by
+``scripts/bench_controller.py`` against the committed
+``benchmarks/BENCH_CONTROLLER_cpu.json``.
+
+Tracked placement is *reality*, not ambition: a tick optimizes a scratch
+chain seeded from the tracked placement and publishes the diff; the tracked
+placement only advances when the executor actually drains the set (a
+non-clean execution schedules a full rebuild).  Superseded sets therefore
+always diff against the placement the backend really has.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from cruise_control_tpu.analyzer import GoalOptimizer
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.proposals import diff as diff_proposals
+from cruise_control_tpu.controller.drift import DriftReport, evaluate_drift
+from cruise_control_tpu.controller.standing import (
+    ControllerJournal,
+    StandingProposalSet,
+)
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.model import arrays as A
+from cruise_control_tpu.model.model_utils import follower_cpu_from_leader_load
+from cruise_control_tpu.monitor.loadmonitor import WindowDelta
+
+
+@dataclasses.dataclass
+class ControllerConfig:
+    """The ``controller.*`` knob block (see core/config_defs.py)."""
+
+    tick_interval_s: float = 30.0
+    drift_threshold: float = 1.0
+    max_rounds_per_tick: int = 64
+    stale_after_s: float = 300.0
+    #: let the controller hand its standing set to the executor (off = the
+    #: set stands for operators / the CONTROLLER endpoint to inspect)
+    execute: bool = False
+
+
+class ContinuousController:
+    """One instance per app, wired behind ``controller.enable``."""
+
+    def __init__(
+        self,
+        cruise_control,
+        journal: Optional[ControllerJournal] = None,
+        config: Optional[ControllerConfig] = None,
+    ) -> None:
+        self.cc = cruise_control
+        self.journal = journal
+        self.cfg = config or ControllerConfig()
+        self._optimizer = GoalOptimizer(
+            goal_ids=cruise_control.goal_ids,
+            hard_ids=cruise_control.hard_ids,
+            enable_heavy_goals=cruise_control.enable_heavy_goals,
+        )
+
+        # warm state (built lazily: the monitor may not have windows yet)
+        self._state = None                 # bucketed device-resident ClusterArrays
+        self._ctx = None
+        self._maps = None
+        self._bucket = 0
+        self._rp_np = None                 # np i32[R] replica_partition
+        self._valid_np = None              # np bool[R]
+        self._part_base = None             # np f32[P, 4] per-partition base load
+        self._part_delta = None            # np f32[P, 4] leadership delta
+        self._broker_fingerprint: Tuple[int, ...] = ()
+
+        #: the last published solve's OUTPUT placement with live loads — the
+        #: state drift is measured on: violations here are violations the
+        #: standing set does NOT answer (None = no standing set; probe the
+        #: tracked state directly)
+        self._candidate_state = None
+        #: post-solve violation vector at the last publish — the drift
+        #: baseline (bounded ticks may leave residual violations; measuring
+        #: against the residual keeps an unsolvable tail from re-triggering
+        #: an identical tick every wake)
+        self._solved_viol = None
+        self._programs_warm_for: Tuple[int, int] = (-1, -1)
+        self._last_drift: Optional[DriftReport] = None
+        self._last_solve_mono = 0.0
+        self._needs_rebuild = False
+
+        self.standing: Optional[StandingProposalSet] = None
+        self._version = 0
+
+        self.paused = False
+        self.pause_reason: Optional[str] = None
+        self.warmed = False
+
+        self._tick_lock = threading.RLock()
+        self._pending_delta = False
+        self._last_delta: Optional[WindowDelta] = None
+        self._last_delta_mono: Optional[float] = None
+        self._shift_t0: Optional[float] = None
+        self._started_mono = time.monotonic()
+        self._last_topology_probe = 0.0
+        self._last_tick_attrs: Optional[dict] = None
+
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- event surface (called from the monitor's sampling thread) -----------
+
+    def on_window_delta(self, delta: WindowDelta) -> None:
+        """Window-completion listener: record and wake — nothing heavy runs
+        on the sampling thread."""
+        self._last_delta = delta
+        self._last_delta_mono = delta.ingest_monotonic
+        if self._shift_t0 is None:
+            # the FIRST load evidence since the last publish anchors the
+            # reaction-latency clock
+            self._shift_t0 = delta.ingest_monotonic
+        self._pending_delta = True
+        self._wake.set()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn the loop thread (wakes on window deltas and on cadence)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="continuous-controller"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self.journal is not None:
+            try:
+                self.journal.close()
+            except Exception:
+                pass
+
+    def _loop(self) -> None:
+        from cruise_control_tpu.core.sensors import (
+            CONTROLLER_TICK_ERRORS_COUNTER,
+            REGISTRY,
+        )
+
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.cfg.tick_interval_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                break
+            try:
+                self.maybe_tick()
+            except Exception:
+                # the loop survives everything — a dead control loop is a
+                # silent outage, the one failure mode this plane must not have
+                REGISTRY.counter(CONTROLLER_TICK_ERRORS_COUNTER).inc()
+
+    def pause(self, reason: str = "operator request") -> None:
+        self.paused = True
+        self.pause_reason = reason
+
+    def resume(self, reason: str = "operator request") -> None:
+        self.paused = False
+        self.pause_reason = reason
+
+    def recover(self) -> int:
+        """Resume the journaled standing proposal set after a crash (the
+        ``Executor.recover()`` analogue for this plane).  Returns the number
+        of journal records replayed; a no-op without a journal."""
+        from cruise_control_tpu.core.sensors import (
+            CONTROLLER_STANDING_PROPOSALS_GAUGE,
+            CONTROLLER_STANDING_VERSION_GAUGE,
+            REGISTRY,
+        )
+
+        if self.journal is None:
+            return 0
+        standing, max_version, records = self.journal.recover()
+        self.standing = standing
+        self._version = max(self._version, max_version)
+        if records > 1:
+            # startup compaction (user-task-WAL pattern): the recovered set
+            # is the only live state — replay cost stays bounded across
+            # restarts instead of accreting superseded history
+            try:
+                self.journal.rewrite(standing)
+            except Exception:
+                pass
+        if standing is not None:
+            REGISTRY.gauge(CONTROLLER_STANDING_VERSION_GAUGE).set(standing.version)
+            REGISTRY.gauge(CONTROLLER_STANDING_PROPOSALS_GAUGE).set(
+                len(standing.proposals)
+            )
+        return records
+
+    # -- warm state ----------------------------------------------------------
+
+    def warm_start(self) -> None:
+        """Build the device-resident cluster state ONCE (bucketed broker
+        axis), plus the per-partition load tables the delta ingest rewrites.
+        Raises ``NotEnoughValidSnapshotsError`` until the monitor has a
+        stable window (callers treat that as "not warm yet")."""
+        from cruise_control_tpu.analyzer.context import (
+            GoalContext,
+            pad_context_brokers,
+        )
+
+        model = self.cc.cluster_model()
+        state, maps = model.to_arrays()
+        B = state.num_brokers
+        bucket = (
+            A.broker_bucket(B) if self._optimizer.bucket_brokers else B
+        )
+        ctx = GoalContext.build(
+            state.num_topics, B, constraint=self.cc.constraint
+        )
+        if bucket != B:
+            state = A.pad_brokers(state, bucket)
+            ctx = pad_context_brokers(ctx, bucket)
+        self._state = jax.device_put(state)
+        self._ctx = ctx
+        self._maps = maps
+        self._bucket = bucket
+        self._broker_fingerprint = tuple(maps.broker_ids)
+
+        self._rp_np = np.asarray(state.replica_partition)
+        self._valid_np = np.asarray(state.replica_valid)
+        self._part_delta = np.array(state.leadership_delta, np.float32)
+        base = np.asarray(state.base_load, np.float32)
+        self._part_base = np.zeros_like(self._part_delta)
+        live = self._valid_np
+        # all replicas of a partition share one base row in monitor-built
+        # models (follower-equivalent load); last-writer-wins is exact there
+        # and a harmless seed elsewhere — the first delta ingest overwrites
+        self._part_base[self._rp_np[live]] = base[live]
+
+        self._candidate_state = None
+        self._solved_viol = None
+        # deltas ingested while cold (warmup sampling, compile burst) are not
+        # load shifts the loop could have reacted to — the reaction clock
+        # starts fresh with the first delta the WARM loop sees
+        self._shift_t0 = None
+        self._needs_rebuild = False
+        self.warmed = True
+        self.warm_programs()
+
+    def warm_programs(self) -> None:
+        """Pre-compile every program a tick can touch, once per shape
+        (``GoalOptimizer.warm_incremental_programs``: the drift probe, the
+        non-donating first-step twin of EVERY goal — any goal can be the
+        first violated one — and the donating chain).  The cold-compile
+        burst lands at warm-start: a controller that compiles during its
+        first real incident would be reacting at compile speed, the exact
+        failure the reaction-latency gate exists to catch.  Idempotent and
+        ~free when the programs are already cached."""
+        if self._programs_warm_for == (self._bucket, self._state.num_replicas):
+            return
+        self._optimizer.warm_incremental_programs(
+            self._state, self._ctx, max_rounds=self.cfg.max_rounds_per_tick
+        )
+        self._programs_warm_for = (self._bucket, self._state.num_replicas)
+
+    def _topology_changed(self) -> bool:
+        try:
+            desc = self.cc.backend.describe_cluster()
+        except Exception:
+            return False
+        return tuple(sorted(desc.brokers)) != self._broker_fingerprint
+
+    def _topology_probe_due(self) -> bool:
+        """Rate-limit the broker-set probe: ``describe_cluster`` is an admin
+        RPC on a real backend, and the reaction-latency hot path must not
+        carry one per tick.  Partition-level changes are caught for free by
+        the ingest's unknown-tp signal; this probe only exists for the
+        replica-less new/removed broker case, which one cadence interval of
+        lag cannot hurt."""
+        now = time.monotonic()
+        if now - self._last_topology_probe < self.cfg.tick_interval_s:
+            return False
+        self._last_topology_probe = now
+        return True
+
+    def _ingest_loads(self) -> int:
+        """Apply the monitor's current window aggregate onto the warm state's
+        load leaves — placement leaves untouched, shapes identical, so the
+        next dispatch reuses the compiled programs.  Returns the number of
+        partitions refreshed; -1 signals a topology change (caller rebuilds).
+        """
+        loads = self.cc.monitor.current_partition_loads()
+        if not loads:
+            return 0
+        pidx = self._maps.partition_index
+        weights = self.cc.monitor.cpu_weights
+        refreshed = 0
+        for tp, (cpu, nw_in, nw_out, disk) in loads.items():
+            p = pidx.get(tp)
+            if p is None:
+                return -1   # unknown partition: the topology moved under us
+            fcpu = float(
+                follower_cpu_from_leader_load(nw_in, nw_out, cpu, weights)
+            )
+            self._part_base[p, Resource.CPU] = fcpu
+            self._part_base[p, Resource.NW_IN] = nw_in
+            self._part_base[p, Resource.NW_OUT] = 0.0
+            self._part_base[p, Resource.DISK] = disk
+            self._part_delta[p, Resource.CPU] = cpu - fcpu
+            self._part_delta[p, Resource.NW_OUT] = nw_out
+            refreshed += 1
+        base = np.where(
+            self._valid_np[:, None], self._part_base[self._rp_np], 0.0
+        ).astype(np.float32)
+        # base_load is replica-axis keyed by replica_partition, which moves
+        # never change — ONE pair of refreshed leaves serves both the tracked
+        # state and the candidate (their placements differ, their loads don't)
+        base_dev = jax.device_put(base)
+        delta_dev = jax.device_put(self._part_delta.copy())
+        self._state = self._state.replace(
+            base_load=base_dev, leadership_delta=delta_dev
+        )
+        if self._candidate_state is not None:
+            self._candidate_state = self._candidate_state.replace(
+                base_load=base_dev, leadership_delta=delta_dev
+            )
+        return refreshed
+
+    def _adopt_placement(self, final_host) -> None:
+        """The executor drained the standing set cleanly: the candidate
+        placement IS reality now — advance the tracked state to it (a fresh
+        snapshot: every replica is original again)."""
+        rb = jax.device_put(np.asarray(final_host.replica_broker))
+        self._state = self._state.replace(
+            replica_broker=rb,
+            replica_disk=jax.device_put(np.asarray(final_host.replica_disk)),
+            partition_leader=jax.device_put(
+                np.asarray(final_host.partition_leader)
+            ),
+            original_broker=rb,
+        )
+        self._candidate_state = None   # candidate IS the tracked state now
+
+    # -- the tick ------------------------------------------------------------
+
+    def maybe_tick(self, force: bool = False) -> Optional[StandingProposalSet]:
+        """One control-loop evaluation: ingest pending deltas, measure drift,
+        and — when drift crosses the threshold, the cadence elapses with
+        violations outstanding, or ``force`` — run the bounded incremental
+        re-optimize and publish the standing proposal set.
+
+        Returns the standing set when this call published one, else None.
+        Synchronous and re-entrant-safe (the HTTP ``action=tick``, the loop
+        thread, and tests all come through here)."""
+        from cruise_control_tpu.monitor.completeness import (
+            NotEnoughValidSnapshotsError,
+        )
+
+        with self._tick_lock:
+            self._update_staleness_gauge()
+            if self.paused:
+                return None
+            if not self.warmed or self._needs_rebuild:
+                try:
+                    self.warm_start()
+                except NotEnoughValidSnapshotsError:
+                    return None   # monitor still warming; next delta retries
+            return self._evaluate_and_tick(force)
+
+    def _evaluate_and_tick(self, force: bool) -> Optional[StandingProposalSet]:
+        from cruise_control_tpu.core.sensors import (
+            CONTROLLER_BALANCEDNESS_GAUGE,
+            CONTROLLER_DRIFT_GAUGE,
+            CONTROLLER_IDLE_TICKS_COUNTER,
+            CONTROLLER_REBUILDS_COUNTER,
+            CONTROLLER_TICKS_COUNTER,
+            REGISTRY,
+        )
+        from cruise_control_tpu.obs import recorder as obs
+
+        token = obs.start_trace("controller_tick")
+        spans: List[obs.Span] = []
+
+        # -- ingest: refresh the load leaves in place -------------------------
+        # the reaction anchor is consumed WITH the evidence: a delta landing
+        # mid-solve re-anchors a fresh clock instead of being wiped by the
+        # solve's completion (its reaction is measured by the NEXT tick).
+        # A skipped/refused tick restores the anchor — unanswered evidence
+        # keeps its clock running.
+        t0 = time.monotonic()
+        had_delta = self._pending_delta
+        self._pending_delta = False
+        anchor = self._shift_t0
+        self._shift_t0 = None
+
+        def _restore_anchor() -> None:
+            if anchor is not None and self._shift_t0 is None:
+                self._shift_t0 = anchor
+
+        refreshed = 0
+        if had_delta:
+            refreshed = self._ingest_loads()
+            if refreshed < 0 or (
+                self._topology_probe_due() and self._topology_changed()
+            ):
+                # the cluster grew/shrank under the warm state: one full
+                # rebuild (counted — this is the expensive path the delta
+                # ingest exists to avoid), standing set invalidated (its
+                # old_replicas may no longer describe reality)
+                REGISTRY.counter(CONTROLLER_REBUILDS_COUNTER).inc()
+                if self.standing is not None and self.journal is not None:
+                    self.journal.invalidated(
+                        self.standing.version, "topology-changed"
+                    )
+                if self.standing is not None:
+                    self.standing = None
+                try:
+                    self.warm_start()
+                except Exception as e:
+                    # the monitor can be momentarily incomplete mid-change;
+                    # flag the rebuild for the next wake instead of dying
+                    # with an unfinished trace
+                    self._needs_rebuild = True
+                    _restore_anchor()
+                    obs.finish_trace(
+                        token, spans=spans,
+                        attrs={"skipped": True, "error": f"rebuild failed: {e}"},
+                    )
+                    return None
+                refreshed = self._ingest_loads()
+        spans.append(
+            obs.Span(
+                "ingest", "ingest", time.monotonic() - t0, 0,
+                attrs={"partitions_refreshed": max(refreshed, 0)},
+            )
+        )
+
+        # -- drift: one compiled dispatch + host math -------------------------
+        # probed on the CANDIDATE state (last solve's output placement, live
+        # loads) when a standing set exists: violations there are the ones
+        # the standing set does NOT answer.  No candidate = probe the
+        # tracked state (everything unanswered).
+        t0 = time.monotonic()
+        probe_state = (
+            self._candidate_state
+            if self._candidate_state is not None
+            else self._state
+        )
+        viol_now = np.asarray(self._optimizer.violations(probe_state, self._ctx))
+        report = evaluate_drift(
+            viol_now, self._solved_viol,
+            self._optimizer.goal_ids, self._optimizer.hard_ids,
+        )
+        self._last_drift = report
+        REGISTRY.gauge(CONTROLLER_DRIFT_GAUGE).set(report.score)
+        REGISTRY.gauge(CONTROLLER_BALANCEDNESS_GAUGE).set(report.balancedness)
+        spans.append(
+            obs.Span(
+                "drift", "drift", time.monotonic() - t0, 1,
+                attrs={
+                    "score": report.score,
+                    "hard_score": report.hard_score,
+                    "violated_goals": report.violated_goals,
+                },
+            )
+        )
+
+        now = time.monotonic()
+        cadence_due = (now - self._last_solve_mono) >= self.cfg.tick_interval_s
+        stale = self._staleness_s() > self.cfg.stale_after_s
+        if force:
+            trigger = "forced"
+        elif stale:
+            # flying blind (no fresh window delta past the stale budget):
+            # solving on stale loads would thrash the standing set with
+            # superseding guesses — hold position until evidence returns
+            # (force bypasses: the operator knows what they're doing)
+            trigger = None
+        elif report.score >= self.cfg.drift_threshold:
+            trigger = "drift"
+        elif cadence_due and report.violated_goal_ids:
+            trigger = "cadence"
+        else:
+            trigger = None
+        if trigger is None:
+            REGISTRY.counter(CONTROLLER_IDLE_TICKS_COUNTER).inc()
+            _restore_anchor()
+            standing = self.standing
+            obs.finish_trace(
+                token, spans=spans,
+                attrs={
+                    "skipped": True,
+                    "stale": stale,
+                    "drift": report.score,
+                    "balancedness": report.balancedness,
+                    "standing_version": (
+                        standing.version if standing else None
+                    ),
+                },
+            )
+            return None
+
+        published = self._tick(
+            token, spans, viol_now, report, trigger, anchor, _restore_anchor
+        )
+        REGISTRY.counter(CONTROLLER_TICKS_COUNTER).inc()
+        return published
+
+    def _tick(
+        self, token, spans, viol_now, report: DriftReport, trigger: str,
+        anchor: Optional[float], restore_anchor,
+    ) -> Optional[StandingProposalSet]:
+        from cruise_control_tpu.core.sensors import (
+            CONTROLLER_PUBLISHED_COUNTER,
+            CONTROLLER_REACTION_TIMER,
+            CONTROLLER_STANDING_PROPOSALS_GAUGE,
+            CONTROLLER_STANDING_VERSION_GAUGE,
+            CONTROLLER_TICK_ERRORS_COUNTER,
+            REGISTRY,
+        )
+        from cruise_control_tpu.obs import recorder as obs
+
+        # -- bounded incremental optimize from the CURRENT placement ----------
+        # viol_now was probed on the candidate when one exists; the optimize
+        # starts from the TRACKED placement, whose violation set can be a
+        # superset (it still carries what the standing set was fixing) — let
+        # incremental_optimize re-probe it (one extra dispatch) in that case
+        t0 = time.monotonic()
+        initial_host = jax.device_get(self._state)
+        final, inc = self._optimizer.incremental_optimize(
+            self._state, self._ctx,
+            max_rounds=self.cfg.max_rounds_per_tick,
+            violations=viol_now if self._candidate_state is None else None,
+        )
+        final_host = jax.device_get(final)
+        spans.append(
+            obs.Span(
+                "optimize", "optimize", time.monotonic() - t0,
+                inc.num_dispatches,
+                attrs={
+                    "goals_run": inc.goals_run,
+                    "moves": inc.total_moves,
+                    "rounds": inc.total_rounds,
+                    "max_rounds_per_tick": self.cfg.max_rounds_per_tick,
+                },
+            )
+        )
+
+        # -- publish the versioned standing set (write-ahead) -----------------
+        t0 = time.monotonic()
+        proposals = diff_proposals(initial_host, final_host, self._maps)
+        reaction_s: Optional[float] = None
+        published: Optional[StandingProposalSet] = None
+        publish_error: Optional[str] = None
+        if proposals:
+            if anchor is not None:
+                reaction_s = time.monotonic() - anchor
+            candidate = StandingProposalSet(
+                version=self._version + 1,
+                created_ms=int(time.time() * 1000),
+                trigger=trigger,
+                drift=report.score,
+                proposals=proposals,
+                reaction_s=reaction_s,
+            )
+            try:
+                if self.journal is not None:
+                    # write-ahead of the in-memory swap: a refused append
+                    # (full disk, simulated crash) leaves the OLD set
+                    # standing — memory and journal never diverge
+                    self.journal.published(candidate)
+                superseded = self.standing
+                self.standing = candidate
+                self._version = candidate.version
+                published = candidate
+                if superseded is not None and self.journal is not None:
+                    self.journal.invalidated(superseded.version, "superseded")
+                if (
+                    self.journal is not None
+                    and self.journal.journal.appends >= 64
+                ):
+                    # supersession churn: everything but the set just
+                    # published is dead state — compact (best-effort; a
+                    # failed rewrite just replays more history)
+                    try:
+                        self.journal.rewrite(candidate)
+                    except Exception:
+                        pass
+                REGISTRY.counter(CONTROLLER_PUBLISHED_COUNTER).inc()
+                REGISTRY.gauge(CONTROLLER_STANDING_VERSION_GAUGE).set(
+                    candidate.version
+                )
+                REGISTRY.gauge(CONTROLLER_STANDING_PROPOSALS_GAUGE).set(
+                    len(proposals)
+                )
+                if reaction_s is not None:
+                    REGISTRY.timer(CONTROLLER_REACTION_TIMER).update(reaction_s)
+            except Exception as e:
+                publish_error = f"{type(e).__name__}: {e}"
+                REGISTRY.counter(CONTROLLER_TICK_ERRORS_COUNTER).inc()
+                # the evidence was NOT answered: its reaction clock resumes
+                restore_anchor()
+        spans.append(
+            obs.Span(
+                "publish", "publish", time.monotonic() - t0, 0,
+                attrs={"proposals": len(proposals), "error": publish_error},
+            )
+        )
+
+        # the new drift reference is this solve's OUTPUT: its placement (the
+        # candidate future drains walk the cluster into) and its residual
+        # violations (bounded rounds may leave a tail — measuring against it
+        # keeps an unsolvable residue from re-triggering identical ticks).
+        # A refused publish changes neither: the old set keeps standing and
+        # the next wake retries against the old baseline.
+        if publish_error is None:
+            if published is not None:
+                self._candidate_state = final
+            self._solved_viol = inc.violations_after
+            self._last_solve_mono = time.monotonic()
+
+        # -- optional drain through the executor (existing policy knobs) ------
+        drained = False
+        if published is not None and publish_error is None and self.cfg.execute:
+            drained = self._drain_standing(final_host)
+
+        attrs = {
+            "skipped": False,
+            "trigger": trigger,
+            "drift": report.score,
+            "balancedness": report.balancedness,
+            "goals_run": inc.goals_run,
+            "moves": inc.total_moves,
+            "num_proposals": len(proposals),
+            "num_dispatches": 1 + inc.num_dispatches,   # drift + optimize
+            "standing_version": self.standing.version if self.standing else None,
+            "reaction_s": reaction_s,
+            "drained": drained,
+            "error": publish_error,
+        }
+        self._last_tick_attrs = attrs
+        obs.finish_trace(token, spans=spans, attrs=attrs)
+        return published
+
+    def _drain_standing(self, final_host) -> bool:
+        """Hand the standing set to the executor under its policy knobs.
+        Clean drain advances the tracked placement to the candidate; a
+        degraded one schedules a full rebuild (reality is now unknown)."""
+        from cruise_control_tpu.core.sensors import (
+            CONTROLLER_DRAINED_COUNTER,
+            CONTROLLER_STANDING_PROPOSALS_GAUGE,
+            REGISTRY,
+        )
+        from cruise_control_tpu.executor.engine import OngoingExecutionError
+
+        standing = self.standing
+        if standing is None:
+            return False
+        try:
+            summary = self.cc.executor.execute_proposals(
+                standing.proposals, wait=True
+            )
+        except OngoingExecutionError:
+            return False   # someone else is executing; the set keeps standing
+        except Exception:
+            self._needs_rebuild = True
+            return False
+        if self.journal is not None:
+            self.journal.drained(standing.version, summary)
+        self.standing = None
+        REGISTRY.counter(CONTROLLER_DRAINED_COUNTER).inc()
+        REGISTRY.gauge(CONTROLLER_STANDING_PROPOSALS_GAUGE).set(0)
+        if summary.succeeded:
+            self._adopt_placement(final_host)
+        else:
+            self._needs_rebuild = True
+        return True
+
+    # -- surface -------------------------------------------------------------
+
+    def _staleness_s(self) -> float:
+        anchor = self._last_delta_mono
+        if anchor is None:
+            anchor = self._started_mono
+        return max(time.monotonic() - anchor, 0.0)
+
+    def _update_staleness_gauge(self) -> None:
+        from cruise_control_tpu.core.sensors import (
+            CONTROLLER_STALENESS_GAUGE,
+            REGISTRY,
+        )
+
+        REGISTRY.gauge(CONTROLLER_STALENESS_GAUGE).set(self._staleness_s())
+
+    def status(self) -> Dict[str, object]:
+        """The CONTROLLER endpoint / STATE block payload."""
+        from cruise_control_tpu.core.sensors import (
+            CONTROLLER_REACTION_TIMER,
+            REGISTRY,
+        )
+
+        self._update_staleness_gauge()
+        staleness = self._staleness_s()
+        reaction = REGISTRY.timer(CONTROLLER_REACTION_TIMER).snapshot()
+        drift = self._last_drift
+        # capture once: the tick/drain thread swaps these without a lock
+        # shared with the HTTP handler
+        standing = self.standing
+        maps = self._maps
+        if self.paused:
+            state = "paused"
+        elif not self.warmed:
+            state = "warming"
+        else:
+            state = "running"
+        return {
+            "state": state,
+            "paused": self.paused,
+            "pauseReason": self.pause_reason,
+            "warmed": self.warmed,
+            "stalenessS": round(staleness, 3),
+            # no fresh window delta for longer than the stale budget: the
+            # loop is flying blind (e.g. a reporter-feed outage) — it stops
+            # reacting but the standing set stays intact (no thrash)
+            "stale": staleness > self.cfg.stale_after_s,
+            "drift": drift.score if drift else 0.0,
+            "balancedness": drift.balancedness if drift else None,
+            "violatedGoals": drift.violated_goals if drift else [],
+            "standing": standing.to_dict() if standing else None,
+            "reaction": {
+                "p50S": reaction["p50_s"],
+                "p95S": reaction["p95_s"],
+                "count": reaction["count"],
+            },
+            "lastTick": self._last_tick_attrs,
+            "topology": {
+                "brokers": len(maps.broker_ids) if maps else 0,
+                "partitions": len(maps.partitions) if maps else 0,
+                "brokerBucket": self._bucket,
+            },
+            "config": {
+                "tickIntervalS": self.cfg.tick_interval_s,
+                "driftThreshold": self.cfg.drift_threshold,
+                "maxRoundsPerTick": self.cfg.max_rounds_per_tick,
+                "staleAfterS": self.cfg.stale_after_s,
+                "execute": self.cfg.execute,
+            },
+        }

@@ -1,0 +1,184 @@
+"""Durable standing proposal set: the controller's published output.
+
+The reference's proposal lifecycle is request-scoped: a runnable computes
+proposals, the executor consumes them, nothing survives either.  The
+continuous controller inverts that — each tick publishes a *versioned standing
+proposal set* that outlives the tick: the executor drains it under the
+existing policy knobs, a newer tick supersedes it, and a crash resumes it.
+
+Durability rides the PR-6 WAL (:class:`~cruise_control_tpu.core.journal.
+Journal`, own ``journal.dir`` namespace ``<dir>/controller``) with three
+record types:
+
+* ``published``  — full proposal wire form (the executor-journal encoding) +
+  version, trigger, drift score.  Written **before** the in-memory set is
+  swapped (write-ahead: a refused append leaves the old set standing, so
+  memory and journal never diverge).
+* ``invalidated`` — an explicit supersession/abandonment marker.  Replay also
+  supersedes implicitly (newest published version wins), so the publish order
+  is crash-safe: publish new → invalidate old; a crash between the two
+  resumes the NEW set.
+* ``drained`` — the executor consumed the set; the journal is then truncated
+  (the standing set is recovery state, not an audit log — the flight
+  recorder is the audit surface), keeping the WAL bounded by one set.
+
+:meth:`ControllerJournal.recover` replays to the current standing set: the
+highest-version ``published`` record with no ``invalidated``/``drained``
+record, exactly what ``Executor.recover()``-style startup resumes instead of
+cold-starting the loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Tuple
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.core.journal import Journal
+from cruise_control_tpu.executor.journal import (
+    proposal_from_record,
+    proposal_to_record,
+)
+
+
+@dataclasses.dataclass
+class StandingProposalSet:
+    """One published, versioned, durable proposal set."""
+
+    version: int
+    created_ms: int
+    #: what caused the publish: "drift" | "cadence" | "forced"
+    trigger: str
+    #: drift score at publish time (violation-count delta vs the last solve)
+    drift: float
+    proposals: List[ExecutionProposal]
+    #: wall seconds from the triggering load-shift delta to this publish
+    #: (None when the tick was cadence/forced with no pending shift)
+    reaction_s: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "createdMs": self.created_ms,
+            "trigger": self.trigger,
+            "drift": self.drift,
+            "numProposals": len(self.proposals),
+            "reactionS": self.reaction_s,
+        }
+
+
+class ControllerJournal:
+    """Typed record layer over one :class:`Journal` directory (see module
+    docstring for the record lifecycle)."""
+
+    def __init__(self, journal: Journal) -> None:
+        self.journal = journal
+
+    @staticmethod
+    def _now_ms() -> int:
+        return int(time.time() * 1000)
+
+    # -- write side ----------------------------------------------------------
+
+    def published(self, standing: StandingProposalSet) -> None:
+        """Write-ahead of the in-memory swap: raises on a refused append."""
+        self.journal.append(
+            {
+                "type": "published",
+                "version": standing.version,
+                "created_ms": standing.created_ms,
+                "trigger": standing.trigger,
+                "drift": standing.drift,
+                "reaction_s": standing.reaction_s,
+                "proposals": [proposal_to_record(p) for p in standing.proposals],
+                "ts_ms": self._now_ms(),
+            }
+        )
+
+    def invalidated(self, version: int, reason: str) -> None:
+        """Best-effort supersession marker (replay supersedes implicitly via
+        newest-version-wins, so a failed append here loses nothing)."""
+        try:
+            self.journal.append(
+                {
+                    "type": "invalidated",
+                    "version": version,
+                    "reason": reason,
+                    "ts_ms": self._now_ms(),
+                }
+            )
+        except Exception:
+            pass
+
+    def drained(self, version: int, summary=None) -> None:
+        """The executor consumed version ``version``; compact the WAL —
+        nothing journaled is live state once the set is drained."""
+        try:
+            self.journal.append(
+                {
+                    "type": "drained",
+                    "version": version,
+                    "execution_id": getattr(summary, "execution_id", None),
+                    "completed": getattr(summary, "completed", None),
+                    "dead": getattr(summary, "dead", None),
+                    "ts_ms": self._now_ms(),
+                }
+            )
+            self.journal.truncate()
+        except Exception:
+            pass
+
+    def rewrite(self, standing: Optional[StandingProposalSet]) -> None:
+        """Compact the WAL to exactly the current standing set (or empty).
+
+        Superseded ``published``/``invalidated`` records are dead state the
+        moment a newer version lands, but ``truncate()`` otherwise only runs
+        on drain — which never happens with ``controller.execute.enable``
+        off, so a long-running publisher would grow the WAL without bound.
+        Callers compact right after a successful publish (and at recovery,
+        bounding restart-to-restart growth).  The crash window between the
+        truncate and the re-append can lose the set — the same class of
+        window the user-task WAL's startup rewrite accepts; the at-risk
+        record here is seconds old and superseded data, never history."""
+        self.journal.truncate()
+        if standing is not None:
+            self.published(standing)
+
+    def close(self) -> None:
+        self.journal.close()
+
+    # -- replay side ---------------------------------------------------------
+
+    def recover(self) -> Tuple[Optional[StandingProposalSet], int, int]:
+        """(standing set or None, max version seen, records replayed).
+
+        The standing set is the highest-version ``published`` record without
+        an ``invalidated``/``drained`` record — the exact set a crashed
+        controller was holding, resumed instead of cold-starting."""
+        records = self.journal.replay()
+        published = {}
+        dead = set()
+        max_version = 0
+        for rec in records:
+            v = int(rec.get("version", 0))
+            max_version = max(max_version, v)
+            rtype = rec.get("type")
+            if rtype == "published":
+                published[v] = rec
+            elif rtype in ("invalidated", "drained"):
+                dead.add(v)
+        live = [v for v in published if v not in dead]
+        if not live:
+            return None, max_version, len(records)
+        v = max(live)
+        rec = published[v]
+        standing = StandingProposalSet(
+            version=v,
+            created_ms=int(rec.get("created_ms", 0)),
+            trigger=str(rec.get("trigger", "recovered")),
+            drift=float(rec.get("drift", 0.0)),
+            proposals=[proposal_from_record(d) for d in rec.get("proposals", [])],
+            reaction_s=rec.get("reaction_s"),
+        )
+        return standing, max_version, len(records)

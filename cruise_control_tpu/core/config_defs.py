@@ -205,11 +205,52 @@ def executor_config() -> ConfigDef:
     return d
 
 
+def controller_config() -> ConfigDef:
+    """Continuous controller (controller/ — TPU-specific, no reference
+    counterpart): streaming drift-triggered incremental rebalancing."""
+    d = ConfigDef()
+    d.define("controller.enable", Type.BOOLEAN, False, H,
+             "Run the continuous control loop: warm device-resident cluster "
+             "state fed by monitor window deltas, drift-gated bounded "
+             "incremental re-optimizes, and a durable standing proposal set "
+             "(journaled under journal.dir/controller when journal.dir is "
+             "set).")
+    d.define("controller.tick.interval.ms", Type.LONG, 30_000, M,
+             "Cadence of the control loop: even sub-threshold drift gets a "
+             "corrective tick at this interval when violations are "
+             "outstanding.", in_range(lo=1))
+    d.define("controller.drift.threshold", Type.DOUBLE, 1.0, M,
+             "Violation-count drift (vs the last published solve's residual) "
+             "that triggers an immediate tick ahead of the cadence.",
+             in_range(lo=0.0))
+    d.define("controller.max.rounds.per.tick", Type.INT, 64, M,
+             "Round cap per goal phase of a tick's bounded incremental "
+             "re-optimize — the knob that keeps a tick's correction "
+             "incremental instead of a full from-scratch-quality walk.",
+             in_range(lo=1))
+    d.define("controller.stale.after.ms", Type.LONG, 300_000, L,
+             "With no fresh metric-window delta for this long, the "
+             "controller flags itself stale in STATE//metrics and stops "
+             "reacting (the standing set stays intact — no thrash on a "
+             "reporter-feed outage).", in_range(lo=1))
+    d.define("controller.execute.enable", Type.BOOLEAN, False, M,
+             "Let the controller hand its standing proposal set to the "
+             "executor (under the existing concurrency/throttle policy "
+             "knobs).  Off = the set stands for operators / the CONTROLLER "
+             "endpoint to inspect and drain manually.")
+    return d
+
+
 def anomaly_detector_config() -> ConfigDef:
     """AnomalyDetectorConfig.java — detection cadence, self-healing, notifier."""
     d = ConfigDef()
     d.define("anomaly.detection.interval.ms", Type.LONG, 300_000, H,
              "Default detector cadence.", in_range(lo=1))
+    d.define("anomaly.detection.initial.pass", Type.BOOLEAN, True, M,
+             "Run one immediate detection pass per detector as soon as the "
+             "readiness ladder reaches ready, instead of sleeping a full "
+             "interval first (a broker that died during the restart window "
+             "would otherwise go unnoticed for up to a whole cadence).")
     d.define("goal.violation.detection.interval.ms", Type.LONG, None, M,
              "Goal-violation detector cadence; unset = anomaly.detection.interval.ms.")
     d.define("broker.failure.detection.interval.ms", Type.LONG, None, M,
@@ -285,6 +326,7 @@ def cruise_control_config() -> ConfigDef:
         monitor_config(),
         analyzer_config(),
         executor_config(),
+        controller_config(),
         anomaly_detector_config(),
         webserver_config(),
     ):

@@ -212,3 +212,18 @@ USER_TASKS_RECOVERED_COUNTER = "UserTaskManager.tasks-recovered"
 READY_GAUGE = "Readiness.ready"
 SAMPLE_STORE_SKIPPED_COUNTER = "SampleStore.replay-records-skipped"
 OPTIMIZE_DEADLINE_COUNTER = "GoalOptimizer.deadline-expirations"
+# continuous controller (controller/loop.py): the reaction-latency timer is
+# the headline metric — p50/p95 time from a load-shift window delta landing
+# to the corrective standing proposal set being published
+CONTROLLER_REACTION_TIMER = "Controller.reaction-latency-timer"
+CONTROLLER_TICKS_COUNTER = "Controller.ticks"
+CONTROLLER_IDLE_TICKS_COUNTER = "Controller.idle-ticks"
+CONTROLLER_TICK_ERRORS_COUNTER = "Controller.tick-errors"
+CONTROLLER_PUBLISHED_COUNTER = "Controller.proposal-sets-published"
+CONTROLLER_DRAINED_COUNTER = "Controller.proposal-sets-drained"
+CONTROLLER_DRIFT_GAUGE = "Controller.drift"
+CONTROLLER_BALANCEDNESS_GAUGE = "Controller.balancedness"
+CONTROLLER_STANDING_VERSION_GAUGE = "Controller.standing-version"
+CONTROLLER_STANDING_PROPOSALS_GAUGE = "Controller.standing-proposals"
+CONTROLLER_STALENESS_GAUGE = "Controller.staleness-seconds"
+CONTROLLER_REBUILDS_COUNTER = "Controller.topology-rebuilds"

@@ -46,13 +46,25 @@ class AnomalyDetectorManager:
         notifier: AnomalyNotifier,
         detectors: Sequence[Tuple[Detector, float]],
         history_limit: int = 10,
+        initial_pass: bool = False,
+        ready_probe=None,
     ) -> None:
         """``detectors``: (detector, interval_s) pairs (the reference schedules 5
-        periodic detectors + 1 continuous, :234-243)."""
+        periodic detectors + 1 continuous, :234-243).
+
+        ``initial_pass=True`` runs one immediate detection pass per detector
+        as soon as ``ready_probe()`` returns truthy (or immediately with no
+        probe) instead of sleeping a full ``interval_s`` first — a broker
+        that died during the restart window would otherwise go unnoticed for
+        up to a whole cadence (``anomaly.detection.initial.pass``; the app
+        shell passes the readiness ladder as the probe so the pass never
+        races journal recovery or an unwarmed monitor)."""
         self.cc = cruise_control
         self.notifier = notifier
         self.detectors = list(detectors)
         self.history_limit = history_limit
+        self.initial_pass = initial_pass
+        self.ready_probe = ready_probe
 
         self._queue: List[Anomaly] = []
         self._cv = threading.Condition()
@@ -90,8 +102,26 @@ class AnomalyDetectorManager:
     # -- detection -----------------------------------------------------------
 
     def _detector_loop(self, detector: Detector, interval_s: float) -> None:
+        if self.initial_pass and self._await_ready():
+            self.run_detector_once(detector)
         while not self._stop.wait(interval_s):
             self.run_detector_once(detector)
+
+    def _await_ready(self) -> bool:
+        """Poll the readiness probe until it opens (the probe is the lazy
+        ``monitor_warming`` → ``ready`` edge — polling it is what flips it).
+        Returns False when shutdown wins the race."""
+        while not self._stop.is_set():
+            probe = self.ready_probe
+            if probe is None:
+                return True
+            try:
+                if probe():
+                    return True
+            except Exception:
+                pass   # a raising probe reads as not-ready; keep waiting
+            self._stop.wait(1.0)
+        return False
 
     def run_detector_once(self, detector: Detector) -> int:
         """One detection cycle (exposed for tests / synchronous drives)."""

@@ -39,7 +39,10 @@ from cruise_control_tpu.core.journal import Journal
 from cruise_control_tpu.executor.tasks import ExecutionTask, TaskState
 
 
-def _proposal_to_record(p: ExecutionProposal) -> dict:
+def proposal_to_record(p: ExecutionProposal) -> dict:
+    """One proposal in journal wire form — shared by the execution WAL and
+    the controller's standing-proposal-set WAL (controller/standing.py), so
+    both planes replay the same encoding."""
     return {
         "tp": list(p.tp),
         "partition_size": p.partition_size,
@@ -49,7 +52,7 @@ def _proposal_to_record(p: ExecutionProposal) -> dict:
     }
 
 
-def _proposal_from_record(d: dict) -> ExecutionProposal:
+def proposal_from_record(d: dict) -> ExecutionProposal:
     return ExecutionProposal(
         tp=(d["tp"][0], int(d["tp"][1])),
         partition_size=float(d["partition_size"]),
@@ -57,6 +60,11 @@ def _proposal_from_record(d: dict) -> ExecutionProposal:
         old_replicas=tuple(int(b) for b in d["old_replicas"]),
         new_replicas=tuple(int(b) for b in d["new_replicas"]),
     )
+
+
+# backwards-compatible aliases (pre-PR-7 internal names)
+_proposal_to_record = proposal_to_record
+_proposal_from_record = proposal_from_record
 
 
 @dataclasses.dataclass

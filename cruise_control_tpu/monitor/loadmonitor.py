@@ -52,6 +52,26 @@ from cruise_control_tpu.monitor.samplestore import NoopSampleStore, SampleStore
 _P_IDX = {info.name: info.id for info in COMMON_METRIC_DEF.all()}
 
 
+@dataclasses.dataclass(frozen=True)
+class WindowDelta:
+    """One metric-window delta as it lands in the aggregators.
+
+    Pushed to :meth:`LoadMonitor.add_window_listener` subscribers after every
+    non-empty sample ingest — the event surface the continuous controller
+    (``controller/``) consumes instead of polling ``cluster_model()`` per
+    request.  ``window_id`` is the newest window the batch touched
+    (``ts // window_ms``); ``new_window`` marks the first delta of a window
+    (the previous window is complete by the aggregator's ring semantics).
+    ``ingest_monotonic`` anchors reaction-latency measurement: time from this
+    load evidence landing to a corrective proposal being published."""
+
+    window_id: int
+    ts_ms: int
+    num_samples: int
+    new_window: bool
+    ingest_monotonic: float
+
+
 class MonitorState:
     NOT_STARTED = "NOT_STARTED"
     RUNNING = "RUNNING"
@@ -109,6 +129,9 @@ class LoadMonitor:
         self._model_semaphore = threading.Semaphore(max_concurrent_model_generations)
         self._sampling_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        #: window-completion subscribers (see :meth:`add_window_listener`)
+        self._window_listeners: List = []
+        self._last_window_id = -1
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -118,9 +141,22 @@ class LoadMonitor:
         (LoadMonitorTaskRunner scheduled sampling)."""
         with self._lock:
             self._state = MonitorState.LOADING
-        replayed = self.sample_store.replay(self._ingest_batch)
+        replay_tail: List[SampleBatch] = []
+
+        def _ingest_replayed(batch: SampleBatch) -> None:
+            self._ingest_batch(batch)
+            if len(batch):
+                replay_tail[:] = [batch]
+
+        replayed = self.sample_store.replay(_ingest_replayed)
         with self._lock:
             self._state = MonitorState.RUNNING
+        if replay_tail:
+            # startup replay rebuilt the window ring: push ONE delta for the
+            # newest replayed batch so push subscribers (the continuous
+            # controller) wake into the warm windows instead of idling until
+            # the next live sample
+            self._notify_windows(replay_tail[0])
         if sampling_interval_ms > 0:
             self._stop.clear()
             self._sampling_thread = threading.Thread(
@@ -181,6 +217,7 @@ class LoadMonitor:
             self.sample_store.store(batch)
             self._ingest_batch(batch)
             self._last_sample_ts = now_ms
+            self._notify_windows(batch)
             return len(batch)
         finally:
             with self._lock:
@@ -198,6 +235,7 @@ class LoadMonitor:
             batch = self.sampler.get_samples(from_ms, to_ms)
             self._ingest_batch(batch)
             self._last_sample_ts = max(self._last_sample_ts, to_ms)
+            self._notify_windows(batch)
             return len(batch)
         finally:
             with self._lock:
@@ -209,6 +247,42 @@ class LoadMonitor:
             self._partition_agg.add_sample(s.tp, s.ts_ms, s.values)
         for s in batch.broker_samples:
             self._broker_agg.add_sample(s.broker_id, s.ts_ms, s.values)
+
+    # -- window-completion events --------------------------------------------
+
+    def add_window_listener(self, fn) -> None:
+        """Subscribe to metric-window deltas (push, not poll).
+
+        ``fn(delta: WindowDelta)`` is invoked synchronously after every
+        non-empty sample ingest (``sample_once`` / ``bootstrap`` / startup
+        replay), on the ingesting thread — listeners must be cheap (record
+        and wake; the continuous controller does exactly that).  A raising
+        listener is swallowed: the sampling loop must never die to a
+        subscriber bug."""
+        self._window_listeners.append(fn)
+
+    def _notify_windows(self, batch: SampleBatch) -> None:
+        if not self._window_listeners or len(batch) == 0:
+            return
+        ts = max(
+            [s.ts_ms for s in batch.partition_samples]
+            + [s.ts_ms for s in batch.broker_samples]
+        )
+        window_id = ts // self.window_ms
+        new_window = window_id > self._last_window_id
+        self._last_window_id = max(self._last_window_id, window_id)
+        delta = WindowDelta(
+            window_id=int(window_id),
+            ts_ms=int(ts),
+            num_samples=len(batch),
+            new_window=new_window,
+            ingest_monotonic=time.monotonic(),
+        )
+        for fn in list(self._window_listeners):
+            try:
+                fn(delta)
+            except Exception:
+                pass
 
     # -- model generation ---------------------------------------------------
 
@@ -389,6 +463,23 @@ class LoadMonitor:
                 float(v[-1, disk_i]),   # LATEST: newest window
             )
         return out
+
+    def current_partition_loads(
+        self,
+    ) -> Dict[TopicPartition, Tuple[float, float, float, float]]:
+        """tp → (cpu, nw_in, nw_out, disk) expected utilization over the
+        current valid windows — the load join of ``cluster_model()`` without
+        the topology/capacity work.  The continuous controller's delta-ingest
+        surface: it refreshes its device-resident load arrays from this map
+        instead of rebuilding the whole model per tick.  Empty until the
+        window ring holds a stable window."""
+        try:
+            vae, _ = self._partition_agg.aggregate(
+                options=AggregationOptions(include_invalid_entities=False)
+            )
+        except NotEnoughValidWindowsError:
+            return {}
+        return self._reduce_windows(vae)
 
     def broker_metric_history(self):
         """(values f32[E, W, M], broker_ids, metric_def) for anomaly finders

@@ -238,6 +238,64 @@ def _run_exporter_tier(inject_sleep_s: float = 0.0) -> dict:
     }
 
 
+def _run_controller_tier(inject_sleep_s: float = 0.0) -> dict:
+    """Continuous-controller tier: reaction-latency p50 over deterministic
+    load shifts + the warm-tick zero-compile contract.
+
+    Measured by the SAME harness that commits
+    ``benchmarks/BENCH_CONTROLLER_cpu.json``
+    (``cruise_control_tpu/controller/bench.py``), and gated against that
+    committed artifact (see ``_controller_baseline``): >25 % reaction-p50
+    regression or ANY XLA compile event attributed to a measured tick fails.
+    A shift that fails to publish a standing set is an infrastructure error —
+    the workload is constructed to violate the disk-capacity goal every
+    round."""
+    _force_cpu_platform()
+    from cruise_control_tpu.controller import bench
+
+    m = bench.run_bench()
+    if m["published"] < m["shifts"]:
+        return {
+            "tier": "controller",
+            "error": f"{m['published']} published sets < {m['shifts']} shifts",
+        }
+    if m["warm_tick_dispatches"] > m["dispatch_budget"]:
+        return {
+            "tier": "controller",
+            "error": (
+                f"{m['warm_tick_dispatches']} tick dispatches > budget "
+                f"{m['dispatch_budget']}"
+            ),
+        }
+    wall = m["reaction_p50_s"]
+    if inject_sleep_s:
+        time.sleep(inject_sleep_s)
+        wall += inject_sleep_s
+    return {
+        "tier": "controller",
+        "platform": "cpu",
+        "wall_s": round(wall, 4),
+        "reaction_p95_s": m["reaction_p95_s"],
+        "warm_tick_dispatches": m["warm_tick_dispatches"],
+        "warm_compile_events": m["warm_compile_events"],
+        "published": m["published"],
+    }
+
+
+def _controller_baseline(root: str) -> Optional[dict]:
+    """Gate baseline for the controller tier, derived from the committed
+    bench artifact (``benchmarks/BENCH_CONTROLLER_cpu.json``) — the ISSUE
+    contract is that the gate enforces THAT file, so the tier never needs a
+    second copy of the number in GATE_BASELINE_cpu.json."""
+    path = os.path.join(root, "benchmarks", "BENCH_CONTROLLER_cpu.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return {"wall_s": doc.get("reaction_p50_s")}
+
+
 TIERS: Dict[str, GateTier] = {
     t.name: t
     for t in (
@@ -253,9 +311,13 @@ TIERS: Dict[str, GateTier] = {
         GateTier("exporter", "/METRICS render wall, fully-populated registry",
                  build=None, bench_comparable=False,
                  runner=_run_exporter_tier),
+        GateTier("controller", "reaction-latency p50 + warm-tick 0-compile "
+                 "contract vs BENCH_CONTROLLER_cpu.json",
+                 build=None, bench_comparable=False,
+                 runner=_run_controller_tier),
     )
 }
-DEFAULT_TIERS = ("config1", "config2_small", "mesh8", "exporter")
+DEFAULT_TIERS = ("config1", "config2_small", "mesh8", "exporter", "controller")
 
 
 # -- measurement --------------------------------------------------------------------
@@ -597,8 +659,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"wall={m['wall_s']}s dispatches={m['num_dispatches']} "
                 f"hard={m['residual_hard_violations']} bal={m['balancedness']}"
             )
-        else:   # runner tiers (exporter) gate wall only
+        elif "series" in m:   # exporter tier gates render wall only
             status = f"wall={m['wall_s']}s series={m.get('series')}"
+        else:   # controller tier: reaction p50 + the zero-compile contract
+            status = (
+                f"reaction_p50={m['wall_s']}s "
+                f"warm_compiles={m.get('warm_compile_events')} "
+                f"published={m.get('published')}"
+            )
         print(f"bench_gate: [{name}] {status}", flush=True)
 
     errors = [m for m in measurements if "error" in m]
@@ -637,6 +705,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         if "error" in m:
             continue
         base = gate_tiers.get(m["tier"])
+        if base is None and m["tier"] == "controller":
+            # the controller tier gates against the committed bench artifact
+            # (benchmarks/BENCH_CONTROLLER_cpu.json), not GATE_BASELINE —
+            # one number, one file, regenerated by scripts/bench_controller.py
+            base = _controller_baseline(root)
         if base is None:
             failures.append(
                 f"{m['tier']}: no committed gate baseline for this tier "

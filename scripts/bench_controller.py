@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Benchmark the continuous controller's reaction latency + warm-tick budget.
+
+The headline metric of the control loop (ROADMAP item 4 / arxiv 2402.06085's
+multi-objective framing): **p50 wall time from a load-shift metric-window
+delta landing to the corrective standing proposal set being published** — not
+per-request solve wall.  The measurement harness lives in
+``cruise_control_tpu/controller/bench.py`` (shared with the ``controller``
+tier of ``obs/gate.py`` and the acceptance tests, so the number the gate
+enforces is measured by the code that committed it): a seeded fake cluster,
+a warmed controller, then K deterministic capacity-violating load shifts
+against the controller's tracked placement.
+
+Regression gate (same pattern as ``scripts/bench_recovery.py``): the measured
+reaction p50 is compared against the committed
+``benchmarks/BENCH_CONTROLLER_cpu.json``; a >25 % regression (after an
+absolute noise floor, × ``CC_TPU_GATE_WALL_SLACK`` on shared runners) exits
+1.  ANY XLA compile event attributed to a measured tick's flight record also
+exits 1 — warm ticks must reuse the programs ``warm_programs()`` compiled at
+warm-start (absolute, baseline-independent, the same contract the solver
+gate enforces on its warm runs).  Fewer published sets than shifts is an
+infrastructure error (exit 2): every measured shift is constructed to
+violate the disk-capacity goal.
+
+    python scripts/bench_controller.py                     # run + gate
+    python scripts/bench_controller.py --update-baseline   # regenerate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SCHEMA = 1
+BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "BENCH_CONTROLLER_cpu.json",
+)
+MAX_WALL_RATIO = 1.25
+WALL_FLOOR_S = 0.05   # reactions are ~10 ms — a sub-noise floor, not 250 ms
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="bench runs; best reaction p50 is gated (noise)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from cruise_control_tpu.controller import bench
+
+    results = []
+    for _ in range(max(args.repeats, 1)):
+        results.append(bench.run_bench())
+    best = min(results, key=lambda r: r["reaction_p50_s"])
+    doc = {"schema": SCHEMA, **best}
+    print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+
+    # self-checks are infrastructure errors, not regressions: the workload is
+    # constructed so every shift must produce a drift-triggered publish, and
+    # the dispatch budget is a property of the tick layout, not the machine
+    if doc["published"] < doc["shifts"]:
+        print(
+            f"controller bench self-check failed: {doc['published']} published "
+            f"sets < {doc['shifts']} shifts",
+            file=sys.stderr,
+        )
+        return 2
+    if doc["warm_tick_dispatches"] > doc["dispatch_budget"]:
+        print(
+            f"controller bench self-check failed: {doc['warm_tick_dispatches']} "
+            f"dispatches > budget {doc['dispatch_budget']}",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.update_baseline:
+        with open(BASELINE, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"baseline written: {BASELINE}", file=sys.stderr)
+        return 0
+
+    if not os.path.exists(BASELINE):
+        print(f"missing baseline {BASELINE}; run --update-baseline", file=sys.stderr)
+        return 2
+    with open(BASELINE) as f:
+        base = json.load(f)
+    if base.get("shifts") != doc["shifts"] or base.get("partitions") != doc["partitions"]:
+        print("workload mismatch vs baseline — regenerate it", file=sys.stderr)
+        return 2
+
+    failures = []
+    # absolute: ANY compile during a measured tick means a shape/static
+    # drifted between identical ticks — reaction at compile speed
+    if doc["warm_compile_events"]:
+        failures.append(
+            f"{doc['warm_compile_events']} XLA compile event(s) during "
+            "measured warm ticks (warm tick => zero compiles)"
+        )
+    slack = float(os.environ.get("CC_TPU_GATE_WALL_SLACK", "1.0"))
+    budget = base["reaction_p50_s"] * MAX_WALL_RATIO * slack + WALL_FLOOR_S
+    if doc["reaction_p50_s"] > budget:
+        failures.append(
+            f"reaction p50 {doc['reaction_p50_s']:.4f}s > budget "
+            f"{budget:.4f}s (baseline {base['reaction_p50_s']:.4f}s × "
+            f"{MAX_WALL_RATIO} × slack {slack} + {WALL_FLOOR_S}s floor)"
+        )
+    if failures:
+        print("CONTROLLER REGRESSION:", file=sys.stderr)
+        for f_ in failures:
+            print(f"  - {f_}", file=sys.stderr)
+        return 1
+    print(
+        f"controller gate OK: reaction p50 {doc['reaction_p50_s']:.4f}s <= "
+        f"budget {budget:.4f}s, 0 warm compiles",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

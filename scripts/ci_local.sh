@@ -24,11 +24,20 @@ python scripts/bench_sim.py --repeats 1 >/dev/null
 echo "== metrics lint (boot app on fake backend, scrape /METRICS, strict exposition parse) =="
 python -m pytest tests/test_telemetry.py -q -k "metrics_lint or content_type"
 
+echo "== openapi drift (docs/openapi.yaml must match the live endpoint registry) =="
+python -m cruise_control_tpu.api.openapi --check docs/openapi.yaml
+
 echo "== recovery tier (crash-safe journal, kill-and-restart, readiness gate) =="
 python -m pytest tests/test_recovery.py -x -q
 
 echo "== recovery bench (cold-restart-to-ready wall vs committed baseline) =="
 python scripts/bench_recovery.py >/dev/null
+
+echo "== controller tier (streaming control loop: drift ticks, standing set, crash recovery) =="
+python -m pytest tests/test_controller.py -x -q
+
+echo "== controller bench (reaction-latency p50 + warm-tick 0-compile vs committed baseline) =="
+python scripts/bench_controller.py >/dev/null
 
 echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check) =="
 python scripts/bench_gate.py

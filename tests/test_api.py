@@ -215,3 +215,18 @@ class TestAnomalyQueueWait:
         mgr._enqueue(fresh)
         t.join(timeout=2.0)
         assert not t.is_alive()
+
+
+class TestOpenApiDrift:
+    def test_committed_yaml_matches_live_registry(self):
+        """Satellite (ISSUE 12): docs/openapi.yaml is generated but nothing
+        refused a stale commit — an endpoint added to the server silently
+        left the published contract behind.  ci_local.sh and the CI test job
+        run `python -m cruise_control_tpu.api.openapi --check` explicitly;
+        this test keeps the same check inside the fast tier."""
+        import os
+
+        from cruise_control_tpu.api.openapi import check_yaml
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        assert check_yaml(os.path.join(root, "docs", "openapi.yaml")) == 0

@@ -37,6 +37,9 @@ def served_app():
         "num.partition.metrics.windows": 4,
         "metric.sampling.interval.ms": 3_600_000,   # manual sampling below
         "anomaly.detection.interval.ms": 3_600_000,
+        # detectors must stay quiet: this module asserts endpoint payloads,
+        # and a background immediate pass would add traces/anomalies under it
+        "anomaly.detection.initial.pass": False,
         "broker.capacity.config.resolver.class":
             "cruise_control_tpu.monitor.capacity.StaticCapacityResolver",
         "sample.store.class":

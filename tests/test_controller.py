@@ -1,0 +1,685 @@
+"""Continuous control loop: streaming, drift-triggered incremental
+rebalancing with a durable standing proposal set (ISSUE 12).
+
+Layered like the subsystem: window-listener + drift math units (no device
+work), standing-journal lifecycle (WAL only), loop behavior over the shared
+bench harness (``controller/bench.py`` — the same workload the committed
+``benchmarks/BENCH_CONTROLLER_cpu.json`` gates), seeded-chaos coverage
+(metric-feed gap must not thrash; pinned crash mid-tick must recover the
+journaled set), the ISSUE acceptance scenario, and the CONTROLLER HTTP
+surface end to end.
+
+Every loop test shares one tick shape (the harness dims + ``max_rounds_per_
+tick=1``), so the per-goal programs compile once for the whole module.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import GoalOptimizer
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.backend.chaos import ChaosBackend, FaultPlan
+from cruise_control_tpu.controller import bench
+from cruise_control_tpu.controller.drift import evaluate_drift
+from cruise_control_tpu.controller.loop import (
+    ContinuousController,
+    ControllerConfig,
+)
+from cruise_control_tpu.controller.standing import (
+    ControllerJournal,
+    StandingProposalSet,
+)
+from cruise_control_tpu.core.journal import Journal, SimulatedCrash
+from cruise_control_tpu.obs import RECORDER
+
+WINDOW_MS = bench.WINDOW_MS
+
+#: one tick shape for the whole module: every harness below uses these, so
+#: the bounded per-goal programs compile exactly once per test session
+TICK_CFG = dict(
+    tick_interval_s=3_600.0,   # cadence off — drift (or force) triggers
+    drift_threshold=1.0,
+    max_rounds_per_tick=1,
+)
+
+
+def make_harness(journal=None, wrap=None, **cfg_overrides):
+    cfg = ControllerConfig(**{**TICK_CFG, **cfg_overrides})
+    return bench.build_harness(journal=journal, config=cfg, wrap=wrap)
+
+
+def feed_shift(monitor, now_ms: int) -> int:
+    """Two windows so the shifted samples land in a STABLE window (the
+    aggregator excludes the still-filling one)."""
+    now_ms += WINDOW_MS
+    monitor.sample_once(now_ms=now_ms)
+    now_ms += WINDOW_MS
+    monitor.sample_once(now_ms=now_ms)
+    return now_ms
+
+
+def apply_shift(backend, controller, victim: int, prev_hot):
+    """Reset the previous hot set, overload the partitions the TRACKED
+    placement hosts on ``victim`` — provably violates the disk-capacity goal
+    wherever earlier ticks moved things."""
+    for tp in prev_hot:
+        backend.set_partition_load(tp, list(bench.BASE_LOAD))
+    hot = bench.hot_partitions_on(controller, victim)
+    for tp in hot:
+        backend.set_partition_load(tp, [0.2, 50.0, 50.0, bench.HOT_DISK])
+    return hot
+
+
+def some_proposals(n: int = 2):
+    return [
+        ExecutionProposal(
+            tp=("T", i), partition_size=1.0, old_leader=0,
+            old_replicas=(0, 1), new_replicas=(0, 2),
+        )
+        for i in range(n)
+    ]
+
+
+# -- window-completion listener (monitor/loadmonitor.py hook) -----------------
+
+
+class TestWindowListener:
+    def _monitor(self, wrap=None):
+        backend, monitor, controller, now_ms = make_harness(wrap=wrap)
+        return backend, monitor, now_ms
+
+    def test_delta_fires_on_samples_with_window_accounting(self):
+        backend, monitor, now_ms = self._monitor()
+        deltas = []
+        monitor.add_window_listener(deltas.append)
+        # the harness clock is window-aligned (bench.build_harness) and the
+        # sample bound is exclusive, so the newest metric of this fetch sits
+        # one metric interval before it — mid-window on purpose, leaving
+        # room for a second same-window delta below
+        monitor.sample_once(now_ms=now_ms + WINDOW_MS // 2)
+        assert len(deltas) == 1
+        d = deltas[0]
+        assert d.num_samples > 0
+        assert d.window_id == d.ts_ms // WINDOW_MS
+        assert d.ts_ms < now_ms + WINDOW_MS // 2
+        assert d.new_window is True
+        assert d.ingest_monotonic <= time.monotonic()
+        # same window again: the delta still fires (it's a load delta), but
+        # the window is no longer new
+        monitor.sample_once(now_ms=now_ms + WINDOW_MS - 10_000)
+        assert len(deltas) == 2
+        assert deltas[1].window_id == d.window_id
+        assert deltas[1].new_window is False
+
+    @pytest.mark.chaos
+    def test_metric_gap_fires_no_delta(self):
+        plan = FaultPlan(seed=3).metric_gap(0, 10_000)   # every fetch empty
+        backend, monitor, now_ms = self._monitor(
+            wrap=lambda b: ChaosBackend(b, plan)
+        )
+        deltas = []
+        monitor.add_window_listener(deltas.append)
+        monitor.sample_once(now_ms=now_ms + WINDOW_MS)
+        assert deltas == []          # an empty batch is not load evidence
+        assert any(kind == "metric_gap" for _, kind, _ in backend.fault_log)
+
+    def test_raising_listener_never_breaks_sampling(self):
+        backend, monitor, now_ms = self._monitor()
+
+        def bomb(delta):
+            raise RuntimeError("subscriber bug")
+
+        seen = []
+        monitor.add_window_listener(bomb)
+        monitor.add_window_listener(seen.append)
+        n = monitor.sample_once(now_ms=now_ms + WINDOW_MS)
+        assert n > 0 and len(seen) == 1
+
+
+# -- drift math ---------------------------------------------------------------
+
+
+class TestDrift:
+    GOALS = (G.RACK_AWARE, G.DISK_CAPACITY, G.DISK_USAGE_DIST)
+    HARD = (G.RACK_AWARE, G.DISK_CAPACITY)
+
+    def test_no_baseline_counts_everything(self):
+        now = np.zeros(G.NUM_GOALS, np.float32)
+        now[G.DISK_CAPACITY] = 3
+        now[G.DISK_USAGE_DIST] = 2
+        r = evaluate_drift(now, None, self.GOALS, self.HARD)
+        assert r.score == 5.0
+        assert r.hard_score == 3.0
+        assert set(r.violated_goal_ids) == {G.DISK_CAPACITY, G.DISK_USAGE_DIST}
+        assert "DiskCapacityGoal" in r.violated_goals
+
+    def test_residual_baseline_suppresses_unsolvable_tail(self):
+        base = np.zeros(G.NUM_GOALS, np.float32)
+        base[G.DISK_USAGE_DIST] = 2          # bounded tick left a residual
+        now = base.copy()
+        r = evaluate_drift(now, base, self.GOALS, self.HARD)
+        assert r.score == 0.0                # same residual: no re-trigger
+        assert r.violated_goal_ids == (G.DISK_USAGE_DIST,)
+        now[G.DISK_CAPACITY] = 1             # new evidence DOES trigger
+        r2 = evaluate_drift(now, base, self.GOALS, self.HARD)
+        assert r2.score == 1.0 and r2.hard_score == 1.0
+
+    def test_balancedness_drop_is_weighted(self):
+        base = np.zeros(G.NUM_GOALS, np.float32)
+        now = base.copy()
+        now[G.RACK_AWARE] = 1
+        r = evaluate_drift(now, base, self.GOALS, self.HARD)
+        assert r.balancedness < 100.0
+        assert r.balancedness_drop == pytest.approx(100.0 - r.balancedness)
+
+
+# -- standing-set journal lifecycle ------------------------------------------
+
+
+class TestStandingJournal:
+    def _journal(self, tmp_path):
+        return ControllerJournal(Journal(str(tmp_path / "controller")))
+
+    def _set(self, version, n=2, trigger="drift"):
+        return StandingProposalSet(
+            version=version, created_ms=123, trigger=trigger, drift=2.0,
+            proposals=some_proposals(n), reaction_s=0.01,
+        )
+
+    def test_publish_supersede_drain_recover(self, tmp_path):
+        j = self._journal(tmp_path)
+        j.published(self._set(1))
+        j.published(self._set(2, n=3))
+        j.invalidated(1, "superseded")
+        standing, max_v, records = ControllerJournal(
+            Journal(str(tmp_path / "controller"))
+        ).recover()
+        assert standing.version == 2 and len(standing.proposals) == 3
+        assert standing.proposals[0].new_replicas == (0, 2)
+        assert max_v == 2 and records == 3
+        # drained ⇒ nothing standing, journal compacted
+        j2 = ControllerJournal(Journal(str(tmp_path / "controller")))
+        j2.drained(2)
+        standing3, max_v3, _ = ControllerJournal(
+            Journal(str(tmp_path / "controller"))
+        ).recover()
+        assert standing3 is None and max_v3 == 0   # truncate wiped history
+
+    def test_crash_between_publish_and_invalidate_resumes_newest(self, tmp_path):
+        j = self._journal(tmp_path)
+        j.published(self._set(1))
+        j.published(self._set(2))
+        # crash before the invalidate record: replay still supersedes
+        # implicitly (newest published version wins)
+        standing, _, _ = ControllerJournal(
+            Journal(str(tmp_path / "controller"))
+        ).recover()
+        assert standing.version == 2
+
+    def test_rewrite_compacts_to_the_standing_set(self, tmp_path):
+        """Bounded growth without drain: supersession churn and recovery
+        both compact the WAL to exactly the live set."""
+        j = self._journal(tmp_path)
+        for v in range(1, 6):
+            j.published(self._set(v))
+            if v > 1:
+                j.invalidated(v - 1, "superseded")
+        assert j.journal.appends == 9
+        j.rewrite(self._set(5, n=3))
+        j2 = ControllerJournal(Journal(str(tmp_path / "controller")))
+        records = j2.journal.replay()
+        assert len(records) == 1 and records[0]["version"] == 5
+        standing, _, _ = j2.recover()
+        assert standing.version == 5 and len(standing.proposals) == 3
+
+    def test_recover_compacts_superseded_history(self, tmp_path):
+        from cruise_control_tpu.controller.loop import ContinuousController
+
+        import types
+
+        j = self._journal(tmp_path)
+        for v in range(1, 4):
+            j.published(self._set(v))
+        facade = types.SimpleNamespace(
+            goal_ids=bench.GOALS,
+            hard_ids=tuple(g for g in bench.GOALS if g in G.HARD_GOALS),
+            enable_heavy_goals=True,
+        )
+        controller = ContinuousController(
+            facade, journal=ControllerJournal(
+                Journal(str(tmp_path / "controller"))
+            ),
+        )
+        assert controller.recover() == 3
+        assert controller.standing.version == 3
+        # the startup rewrite left exactly the live set behind
+        replayed = Journal(str(tmp_path / "controller")).replay()
+        assert len(replayed) == 1 and replayed[0]["version"] == 3
+
+    def test_refused_publish_append_raises(self, tmp_path):
+        j = self._journal(tmp_path)
+        j.published(self._set(1))
+        j.journal.crash_after_appends = j.journal.appends
+        with pytest.raises(SimulatedCrash):
+            j.published(self._set(2))
+        # the WAL still holds (only) version 1 — write-ahead means the
+        # in-memory swap never happened either (loop.py catches and keeps v1)
+        standing, _, _ = ControllerJournal(
+            Journal(str(tmp_path / "controller"))
+        ).recover()
+        assert standing.version == 1
+
+
+# -- loop behavior ------------------------------------------------------------
+
+
+class TestControllerLoop:
+    def test_shift_drift_tick_publishes_and_supersedes(self, tmp_path):
+        journal = ControllerJournal(Journal(str(tmp_path / "controller")))
+        backend, monitor, controller, now_ms = make_harness(journal=journal)
+        controller.warm_start()
+        hot = apply_shift(backend, controller, 0, [])
+        now_ms = feed_shift(monitor, now_ms)
+        s1 = controller.maybe_tick()
+        assert s1 is not None and s1.version == 1 and s1.trigger == "drift"
+        assert len(s1.proposals) > 0
+        # every proposal starts from the CURRENT (tracked) placement
+        placement = {
+            tp: brokers
+            for tp, brokers in _tracked_placement(controller).items()
+        }
+        for p in s1.proposals:
+            assert set(p.old_replicas) == set(placement[p.tp])
+        # second shift supersedes: version bumps, journal carries both the
+        # new publish and the explicit invalidation of v1
+        apply_shift(backend, controller, 1, hot)
+        now_ms = feed_shift(monitor, now_ms)
+        s2 = controller.maybe_tick()
+        assert s2 is not None and s2.version == 2
+        assert controller.standing is s2
+        records = journal.journal.replay()
+        kinds = [(r["type"], r.get("version")) for r in records]
+        assert ("published", 2) in kinds and ("invalidated", 1) in kinds
+
+    def test_idle_wake_skips_without_load_change(self):
+        backend, monitor, controller, now_ms = make_harness()
+        controller.warm_start()
+        hot = apply_shift(backend, controller, 0, [])
+        now_ms = feed_shift(monitor, now_ms)
+        assert controller.maybe_tick() is not None
+        # same loads, fresh windows: drift vs the candidate's residual is 0
+        now_ms = feed_shift(monitor, now_ms)
+        assert controller.maybe_tick() is None
+        trace = next(iter(RECORDER.recent(1, kind="controller_tick")))
+        assert trace.attrs["skipped"] is True
+        assert controller.standing.version == 1   # no thrash
+
+    def test_pause_and_resume(self):
+        backend, monitor, controller, now_ms = make_harness()
+        controller.warm_start()
+        controller.pause("maintenance")
+        apply_shift(backend, controller, 0, [])
+        now_ms = feed_shift(monitor, now_ms)
+        assert controller.maybe_tick() is None
+        assert controller.status()["state"] == "paused"
+        controller.resume("done")
+        s = controller.maybe_tick()
+        assert s is not None and controller.status()["state"] == "running"
+
+    @pytest.mark.chaos
+    def test_metric_gap_leaves_standing_set_intact_and_flags_stale(self):
+        """Satellite: a FaultPlan.metric_gap window must not thrash the
+        standing set, and the staleness must surface in STATE//metrics."""
+        from cruise_control_tpu.obs.exporter import render_prometheus
+
+        plan = FaultPlan(seed=11)
+        backend, monitor, controller, now_ms = make_harness(
+            wrap=lambda b: ChaosBackend(b, plan), stale_after_s=0.05
+        )
+        controller.warm_start()
+        apply_shift(backend, controller, 0, [])
+        now_ms = feed_shift(monitor, now_ms)
+        s1 = controller.maybe_tick()
+        assert s1 is not None
+
+        # the feed goes dark: every later fetch returns nothing
+        plan.metric_gap(
+            backend.calls.get("fetch_raw_metrics", 0), 10_000
+        )
+        for _ in range(3):
+            now_ms += WINDOW_MS
+            assert monitor.sample_once(now_ms=now_ms) == 0
+            controller.maybe_tick()
+        time.sleep(0.06)
+        status = controller.status()
+        assert status["stale"] is True
+        assert status["stalenessS"] > 0.05
+        # the standing set survived the outage untouched
+        assert controller.standing is s1
+        assert status["standing"]["version"] == 1
+        page = render_prometheus()
+        assert 'family="Controller",sensor="staleness-seconds"' in page
+
+    @pytest.mark.chaos
+    def test_crash_mid_tick_recovers_journaled_standing_set(self, tmp_path):
+        """Satellite: a pinned crash_after mid-tick must recover to the
+        journaled standing set on restart.  The death is pinned at BOTH
+        process surfaces a tick touches — every southbound call past the pin
+        dies (FaultPlan.crash_after) and the next journal append dies before
+        writing (crash_after_appends) — exactly a process killed between the
+        solve and its publish."""
+        plan = FaultPlan(seed=5)
+        jdir = str(tmp_path / "controller")
+        journal = ControllerJournal(Journal(jdir))
+        backend, monitor, controller, now_ms = make_harness(
+            journal=journal, wrap=lambda b: ChaosBackend(b, plan)
+        )
+        controller.warm_start()
+        hot = apply_shift(backend, controller, 0, [])
+        now_ms = feed_shift(monitor, now_ms)
+        s1 = controller.maybe_tick()
+        assert s1 is not None and s1.version == 1
+
+        # pin the crash: every further southbound call AND the next journal
+        # append (v2's publish) die — the shifted windows below are already
+        # ingested, so the tick solves then dies publishing
+        journal.journal.crash_after_appends = journal.journal.appends
+        apply_shift(backend, controller, 1, hot)
+        now_ms = feed_shift(monitor, now_ms)
+        plan.crash_after("*", backend.total_calls)    # southbound blackout
+        assert controller.maybe_tick() is None        # publish refused
+        assert controller.standing is s1              # write-ahead: no swap
+        trace = next(iter(RECORDER.recent(1, kind="controller_tick")))
+        assert "SimulatedCrash" in (trace.attrs.get("error") or "")
+
+        # "restart": fresh journal + controller on the same directory
+        controller2 = ContinuousController(
+            controller.cc,
+            journal=ControllerJournal(Journal(jdir)),
+            config=ControllerConfig(**TICK_CFG),
+        )
+        records = controller2.recover()
+        assert records >= 1
+        recovered = controller2.standing
+        assert recovered is not None and recovered.version == 1
+        assert [
+            (p.tp, p.old_replicas, p.new_replicas) for p in recovered.proposals
+        ] == [
+            (p.tp, p.old_replicas, p.new_replicas) for p in s1.proposals
+        ]
+
+
+def _tracked_placement(controller):
+    """tp -> tuple of broker ids in the controller's tracked state."""
+    state = jax.device_get(controller._state)
+    rb = np.asarray(state.replica_broker)
+    out = {}
+    for row in np.nonzero(np.asarray(state.replica_valid))[0]:
+        p = int(np.asarray(state.replica_partition)[row])
+        tp = controller._maps.partitions[p]
+        out.setdefault(tp, []).append(
+            controller._maps.broker_ids[int(rb[row])]
+        )
+    return out
+
+
+# -- the ISSUE acceptance scenario -------------------------------------------
+
+
+class TestAcceptance:
+    def test_warm_tick_budgets_incrementality_and_crash_resume(self, tmp_path):
+        """After warmup, a controller tick responding to an injected load
+        shift runs with 0 compile events and within a fixed dispatch budget
+        (asserted from the obs flight record), starts from the current
+        placement with a move count strictly below a from-scratch solve for
+        the same shift, and a kill-and-restart resumes the exact journaled
+        standing proposal set; reaction-latency p50 appears on /metrics and
+        the committed BENCH_CONTROLLER_cpu.json is enforced by the gate."""
+        from cruise_control_tpu.obs.exporter import render_prometheus
+
+        jdir = str(tmp_path / "controller")
+        journal = ControllerJournal(Journal(jdir))
+        backend, monitor, controller, now_ms = make_harness(journal=journal)
+        controller.warm_start()   # pays the compile burst (warm_programs)
+
+        # warmup shift: settles the placement + drift baseline.  Even this
+        # FIRST tick must be compile-free: warm_programs() pre-compiled the
+        # non-donating first-step twin of EVERY goal, so a tick whose first
+        # violated goal is not goal_ids[0] (here: DiskCapacityGoal) cannot
+        # compile mid-incident
+        hot = apply_shift(backend, controller, 0, [])
+        now_ms = feed_shift(monitor, now_ms)
+        assert controller.maybe_tick() is not None
+        first_trace = next(iter(RECORDER.recent(1, kind="controller_tick")))
+        assert first_trace.attrs["skipped"] is False
+        assert G.GOAL_NAMES[bench.GOALS[0]] not in first_trace.attrs["goals_run"]
+        assert first_trace.compile_events == []
+
+        # ---- the measured load shift ------------------------------------
+        apply_shift(backend, controller, 1, hot)
+        now_ms = feed_shift(monitor, now_ms)
+        pre_tick_state = jax.device_get(controller._state)   # for the scratch solve
+        standing = controller.maybe_tick()
+        assert standing is not None and standing.version == 2
+
+        # flight record: 0 compiles, bounded dispatches, a real reaction
+        trace = next(iter(RECORDER.recent(1, kind="controller_tick")))
+        assert trace.attrs["skipped"] is False
+        assert trace.compile_events == []                    # warm tick
+        budget = len(bench.GOALS) + 3
+        assert trace.attrs["num_dispatches"] <= budget
+        assert sum(s.dispatches for s in trace.spans) == trace.attrs["num_dispatches"]
+        assert standing.reaction_s is not None and standing.reaction_s > 0
+
+        # starts from the current placement…
+        placement = _tracked_placement(controller)
+        for p in standing.proposals:
+            assert set(p.old_replicas) == set(placement[p.tp])
+
+        # …with strictly fewer moves than a from-scratch solve of the SAME
+        # shifted state (the full goal walk at full round budget)
+        scratch = GoalOptimizer(
+            goal_ids=bench.GOALS,
+            hard_ids=tuple(g for g in bench.GOALS if g in G.HARD_GOALS),
+        )
+        _, scratch_result = scratch.optimize(
+            jax.device_put(pre_tick_state), controller._ctx,
+            maps=controller._maps,
+        )
+        assert len(scratch_result.proposals) > 0
+        assert 0 < len(standing.proposals) < len(scratch_result.proposals)
+        assert trace.attrs["moves"] < scratch_result.total_moves
+
+        # reaction-latency p50 on /metrics
+        page = render_prometheus()
+        assert (
+            'cruise_control_tpu_timer_seconds{family="Controller",'
+            'sensor="reaction-latency-timer",stat="p50"' in page
+        )
+
+        # ---- kill-and-restart: resume the exact journaled standing set --
+        # no close(), no graceful anything: the .open segment IS the crash
+        controller3 = ContinuousController(
+            controller.cc,
+            journal=ControllerJournal(Journal(jdir)),
+            config=ControllerConfig(**TICK_CFG),
+        )
+        controller3.recover()
+        resumed = controller3.standing
+        assert resumed is not None
+        assert resumed.version == standing.version
+        assert [
+            (p.tp, p.old_replicas, p.new_replicas) for p in resumed.proposals
+        ] == [
+            (p.tp, p.old_replicas, p.new_replicas) for p in standing.proposals
+        ]
+
+        # the committed bench artifact exists and the gate enforces it
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        artifact = os.path.join(root, "benchmarks", "BENCH_CONTROLLER_cpu.json")
+        assert os.path.exists(artifact)
+        with open(artifact) as f:
+            doc = json.load(f)
+        assert doc["warm_compile_events"] == 0
+        assert doc["reaction_p50_s"] > 0
+        from cruise_control_tpu.obs.gate import (
+            DEFAULT_TIERS,
+            _controller_baseline,
+        )
+
+        assert "controller" in DEFAULT_TIERS
+        assert _controller_baseline(root)["wall_s"] == doc["reaction_p50_s"]
+
+
+# -- executor drain (controller.execute.enable) -------------------------------
+
+
+class TestExecutorDrain:
+    def test_clean_drain_advances_tracked_placement(self, tmp_path):
+        journal = ControllerJournal(Journal(str(tmp_path / "controller")))
+        backend, monitor, controller, now_ms = make_harness(
+            journal=journal, execute=True
+        )
+        controller.warm_start()
+        apply_shift(backend, controller, 0, [])
+        now_ms = feed_shift(monitor, now_ms)
+        published = controller.maybe_tick()
+        assert published is not None
+        # executed and drained: nothing standing, journal compacted,
+        # the backend actually moved the replicas
+        assert controller.standing is None
+        standing, _, _ = ControllerJournal(
+            Journal(str(tmp_path / "controller"))
+        ).recover()
+        assert standing is None
+        assert any(name == "reassign" for name, _ in backend.admin_log)
+        # tracked placement == backend placement now
+        placement = _tracked_placement(controller)
+        live = {
+            i.tp: list(i.replicas)
+            for infos in backend.describe_topics().values()
+            for i in infos
+        }
+        for tp, brokers in placement.items():
+            assert set(brokers) == set(live[tp])
+
+
+# -- the CONTROLLER HTTP surface ---------------------------------------------
+
+
+GOAL_NAMES_CSV = ",".join(G.GOAL_NAMES[g] for g in bench.GOALS)
+
+
+class TestControllerEndpoint:
+    @pytest.fixture()
+    def served(self, tmp_path):
+        from cruise_control_tpu.app import CruiseControlTpuApp
+        from cruise_control_tpu.backend import FakeClusterBackend
+        from cruise_control_tpu.client import CruiseControlClient
+        from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+
+        backend = FakeClusterBackend()
+        for b in range(bench.BROKERS):
+            backend.add_broker(b, rack=str(b % bench.RACKS))
+        for p in range(bench.PARTITIONS):
+            backend.create_partition(
+                ("T", p), [p % bench.BROKERS, (p + 1) % bench.BROKERS],
+                load=list(bench.BASE_LOAD),
+            )
+        props = {
+            "partition.metrics.window.ms": WINDOW_MS,
+            "num.partition.metrics.windows": bench.NUM_WINDOWS,
+            "metric.sampling.interval.ms": 3_600_000,
+            "anomaly.detection.interval.ms": 3_600_000,
+            "anomaly.detection.initial.pass": False,
+            "broker.capacity.config.resolver.class":
+                "cruise_control_tpu.monitor.capacity.StaticCapacityResolver",
+            "sample.store.class":
+                "cruise_control_tpu.monitor.samplestore.NoopSampleStore",
+            "webserver.http.port": 0,
+            "min.valid.partition.ratio": 0.5,
+            # same trimmed goals + tick shape as the rest of the module so
+            # the compiled programs are already warm
+            "default.goals": GOAL_NAMES_CSV,
+            "controller.enable": True,
+            "controller.tick.interval.ms": 3_600_000,
+            "controller.max.rounds.per.tick": 1,
+            "journal.dir": str(tmp_path / "journal"),
+        }
+        app = CruiseControlTpuApp(props, backend=backend)
+        app.monitor.capacity_resolver = StaticCapacityResolver(bench.CAPACITY)
+        now = int(time.time() * 1000)
+        for w in range(bench.NUM_WINDOWS + 2):
+            app.monitor.sample_once(now_ms=now + w * WINDOW_MS)
+        app.start(serve_http=True)
+        client = CruiseControlClient(
+            f"http://127.0.0.1:{app.port}", poll_timeout_s=600.0
+        )
+        yield app, backend, client, now + (bench.NUM_WINDOWS + 2) * WINDOW_MS
+        app.stop()
+
+    def test_status_tick_pause_resume_state_and_schema(self, served):
+        from cruise_control_tpu.api.schemas import validate_endpoint
+
+        app, backend, client, now_ms = served
+        body = client.controller_status()
+        assert body["enabled"] is True
+        validate_endpoint("CONTROLLER", body)
+
+        # force one tick over HTTP: warm-starts the loop
+        body = client.controller_tick()
+        assert body["action"] == "tick" and body["warmed"] is True
+        validate_endpoint("CONTROLLER", body)
+
+        # a real load shift through the monitor → drift tick → standing set
+        hot = bench.hot_partitions_on(app.controller, 0)
+        for tp in hot:
+            backend.set_partition_load(tp, [0.2, 50.0, 50.0, bench.HOT_DISK])
+        now_ms += WINDOW_MS
+        app.monitor.sample_once(now_ms=now_ms)
+        now_ms += WINDOW_MS
+        app.monitor.sample_once(now_ms=now_ms)
+        # the app's loop thread races this manual tick on the same lock —
+        # whoever wins, a standing set must appear
+        app.controller.maybe_tick()
+        deadline = time.monotonic() + 30.0
+        while app.controller.standing is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        standing = app.controller.standing
+        assert standing is not None
+        body = client.controller_status()
+        assert body["standing"]["version"] == standing.version
+        assert body["reaction"]["count"] >= 1
+
+        # pause/resume through the POST switch
+        assert client.controller_pause(reason="ops")["paused"] is True
+        assert app.controller.paused
+        assert client.controller_resume()["paused"] is False
+
+        # STATE carries the Controller block; /metrics carries the sensors
+        state = client.state()
+        assert state["Controller"]["state"] in ("running", "paused")
+        page = client.metrics()
+        assert 'sensor="reaction-latency-timer"' in page
+
+    def test_unconfigured_controller_answers_disabled(self, served):
+        # a bare CruiseControlApp (no controller wired) — endpoint answers
+        # {"enabled": false} on GET and 400 on POST
+        from cruise_control_tpu.api.server import CruiseControlApp
+
+        app, _, _, _ = served
+        bare = CruiseControlApp(app.cruise_control)
+        status, body = bare.get_controller({})
+        assert status == 200 and body == {"enabled": False}
+        status, body, _ = bare.post_controller({"action": ["pause"]})
+        assert status == 400
+        status, body, _ = app.app.post_controller({"action": ["bogus"]})
+        assert status == 400

@@ -6,6 +6,8 @@ Mirrors the reference's detector test tier (``AnomalyDetectorManagerTest``,
 run in-process on :class:`FakeClusterBackend` instead of embedded Kafka.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -25,6 +27,7 @@ from cruise_control_tpu.detector import (
     SelfHealingNotifier,
     TopicReplicationFactorAnomalyFinder,
 )
+from cruise_control_tpu.detector.detectors import Detector
 from cruise_control_tpu.executor import Executor
 from cruise_control_tpu.facade import CruiseControl
 from cruise_control_tpu.monitor import (
@@ -206,3 +209,100 @@ class TestManagerState:
         assert st.queue_size == 1
         assert st.recent_anomalies["BROKER_FAILURE"]
         assert st.self_healing_enabled["GOAL_VIOLATION"] is True
+
+
+class TestInitialDetectionPass:
+    """Satellite (ISSUE 12): detectors used to sleep a full interval before
+    their FIRST pass (`_detector_loop` entered `self._stop.wait(interval_s)`
+    straight away) — a broker that died during the restart window went
+    unnoticed for up to a whole cadence.  With
+    ``anomaly.detection.initial.pass`` each detector runs one immediate pass
+    as soon as the readiness probe opens."""
+
+    class _CountingDetector(Detector):
+        name = "CountingDetector"
+
+        def __init__(self):
+            self.runs = 0
+
+        def run(self):
+            self.runs += 1
+            return []
+
+    def _poll(self, fn, timeout_s=10.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if fn():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_immediate_pass_fires_once_ready(self):
+        backend, monitor, cc = build_cc()
+        det = self._CountingDetector()
+        ready = {"ok": False}
+        manager = AnomalyDetectorManager(
+            cc, NoopNotifier(), detectors=[(det, 3_600.0)],
+            initial_pass=True, ready_probe=lambda: ready["ok"],
+        )
+        manager.start_detection()
+        try:
+            time.sleep(0.3)
+            assert det.runs == 0          # gate closed: no pass yet
+            ready["ok"] = True
+            assert self._poll(lambda: det.runs >= 1)
+            time.sleep(0.3)
+            assert det.runs == 1          # exactly one immediate pass
+        finally:
+            manager.shutdown()
+
+    def test_default_behavior_unchanged_without_initial_pass(self):
+        backend, monitor, cc = build_cc()
+        det = self._CountingDetector()
+        manager = AnomalyDetectorManager(
+            cc, NoopNotifier(), detectors=[(det, 3_600.0)]
+        )
+        manager.start_detection()
+        try:
+            time.sleep(0.4)
+            assert det.runs == 0          # first pass waits the interval
+        finally:
+            manager.shutdown()
+
+    def test_raising_probe_reads_as_not_ready(self):
+        backend, monitor, cc = build_cc()
+        det = self._CountingDetector()
+
+        def probe():
+            raise RuntimeError("backend down")
+
+        manager = AnomalyDetectorManager(
+            cc, NoopNotifier(), detectors=[(det, 3_600.0)],
+            initial_pass=True, ready_probe=probe,
+        )
+        manager.start_detection()
+        try:
+            time.sleep(0.3)
+            assert det.runs == 0
+        finally:
+            manager.shutdown()
+
+    def test_app_wires_probe_from_readiness_ladder(self, tmp_path):
+        from cruise_control_tpu.app import CruiseControlTpuApp
+
+        backend = FakeClusterBackend()
+        backend.add_broker(0, rack="0")
+        backend.create_partition(("T", 0), [0], load=[1, 1, 1, 1])
+        app = CruiseControlTpuApp(
+            {
+                "webserver.http.port": 0,
+                "anomaly.detection.interval.ms": 3_600_000,
+                "sample.store.class":
+                    "cruise_control_tpu.monitor.samplestore.NoopSampleStore",
+            },
+            backend=backend,
+        )
+        assert app.anomaly_manager.initial_pass is True
+        assert app.anomaly_manager.ready_probe is not None
+        # the probe is the readiness ladder: closed until the app starts
+        assert app.anomaly_manager.ready_probe() is False

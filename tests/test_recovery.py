@@ -613,6 +613,10 @@ def app_props(tmp_path, journal=True):
         "num.partition.metrics.windows": 4,
         "metric.sampling.interval.ms": 3_600_000,    # manual sampling only
         "anomaly.detection.interval.ms": 3_600_000,  # detectors never fire
+        # the immediate-on-ready pass would race these tests' drain-queue
+        # assertions (the ExecutionFailureDetector consumes recovered
+        # summaries exactly-once); its own coverage lives in test_detector
+        "anomaly.detection.initial.pass": False,
         "broker.capacity.config.resolver.class":
             "cruise_control_tpu.monitor.capacity.StaticCapacityResolver",
         "sample.store.class":
