@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+from cruise_control_tpu.api.admission import CHEAP_ENDPOINTS
 from cruise_control_tpu.api.schemas import RESPONSE_SCHEMAS
 from cruise_control_tpu.api.server import (
     API_PREFIX,
@@ -48,7 +49,31 @@ _ASYNC_PARAMS = [
     {"name": "review_id", "in": "query", "required": False,
      "schema": {"type": "integer"},
      "description": "approved two-step-verification request to execute"},
+    {"name": "deadline_ms", "in": "query", "required": False,
+     "schema": {"type": "integer"},
+     "description": ("client budget in milliseconds: bounds the admission-"
+                     "queue wait (an over-deadline queued request sheds with "
+                     "429 before reaching the solver) and becomes the "
+                     "per-request optimize deadline — an expiring solve "
+                     "returns best-so-far marked degraded=true")},
 ]
+
+#: the load-shedding contract (api/admission.py): every shed is a 429 with a
+#: Retry-After derived from queue depth and drain rate — never a 500
+_SHED_RESPONSE = {
+    "description": (
+        "shed by admission control (rate limit, per-principal quota, full "
+        "queue, over-deadline queue wait, or the active-task cap); the "
+        "Retry-After header is derived from live queue depth and drain rate"
+    ),
+    "headers": {
+        "Retry-After": {
+            "schema": {"type": "integer"},
+            "description": "seconds until a retry is likely to be admitted",
+        }
+    },
+    "content": {"application/json": {"schema": {"type": "object"}}},
+}
 
 #: POSTs that answer synchronously in the handler thread — no user task, no
 #: 202, no async params (CONTROLLER pause/resume/tick is a switch on the
@@ -118,7 +143,8 @@ _ENDPOINT_PARAMS = {
         {"name": "kind", "in": "query", "required": False,
          "schema": {"type": "string"},
          "description": ("trace kind filter: optimize | execution | detector "
-                         "| model | simulate | user_task | retry | ...")},
+                         "| model | simulate | user_task | retry | "
+                         "admission | ...")},
         {"name": "trace_id", "in": "query", "required": False,
          "schema": {"type": "string"},
          "description": "exact trace id"},
@@ -206,6 +232,10 @@ def generate_openapi() -> Dict[str, Any]:
             responses: Dict[str, Any] = {
                 "200": {"description": "success", "content": content}
             }
+            if name not in CHEAP_ENDPOINTS:
+                # every non-cheap endpoint can be shed by admission control;
+                # cheap reads and operator escape hatches always bypass
+                responses["429"] = _SHED_RESPONSE
             params = list(_COMMON_PARAMS)
             if method == "post" and name not in _SYNC_POST_ENDPOINTS:
                 responses["202"] = {

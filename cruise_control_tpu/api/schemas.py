@@ -147,6 +147,33 @@ _READINESS = {
     "recovery": dict,
 }
 
+#: STATE.Admission (api/admission.py): the overload plane's accounting
+_ADMISSION = {
+    "enabled": bool,
+    "admitted": int,
+    "shed": int,
+    "shedByReason": dict,
+    "active": int,
+    "activeByPrincipal": dict,
+    "queueDepth": int,
+    "queueCapacity": int,
+    "maxConcurrent": int,
+    "rateQps": float,
+    "maxTasksPerPrincipal": int,
+}
+
+#: STATE.Breaker (backend/breaker.py): the circuit-breaker state machine
+_BREAKER = {
+    "state": str,                    # closed | open | half_open
+    "consecutiveFailures": int,
+    "opens": int,
+    "closes": int,
+    "probes": int,
+    "fastFailures": int,
+    "cooldownS": float,
+    "lastError": (str, None),
+}
+
 #: endpoint name (CruiseControlEndPoint.java:16-39) -> response schema
 RESPONSE_SCHEMAS: Dict[str, Any] = {
     "STATE": {
@@ -160,6 +187,8 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
             "memory": [dict],
         },
         "?Readiness": _READINESS,
+        "?Admission": _ADMISSION,
+        "?Breaker": _BREAKER,
         "?Controller": dict,
     },
     "HEALTHZ": {"status": str, **_READINESS},
@@ -171,7 +200,15 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
         "?cached": bool,
         "?dryrun": bool,
         #: true when optimize.deadline.ms expired mid-walk (best-so-far body)
+        #: OR when the breaker-open degraded path served the standing set
         "?degraded": bool,
+        #: breaker-open degraded answer: served from the journaled standing
+        #: proposal set instead of a fresh solve (backend unavailable)
+        "?breakerOpen": bool,
+        "?standingVersion": int,
+        "?trigger": str,
+        "?createdMs": int,
+        "?numProposals": int,
         "?violations_before": dict,
         "?violations_after": dict,
         "?provision": (dict, str),

@@ -36,13 +36,25 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.api import admission as adm
+from cruise_control_tpu.api.admission import (
+    AdmissionController,
+    AdmissionRefused,
+    CHEAP_ENDPOINTS,
+    RequestContext,
+    principal_of,
+)
 from cruise_control_tpu.api.purgatory import Purgatory
 from cruise_control_tpu.api.security import (
     AuthenticationError,
     NoSecurityProvider,
     SecurityProvider,
 )
-from cruise_control_tpu.api.usertasks import TaskStatus, UserTaskManager
+from cruise_control_tpu.api.usertasks import (
+    TaskStatus,
+    TooManyUserTasksError,
+    UserTaskManager,
+)
 from cruise_control_tpu.core.resources import Resource
 from cruise_control_tpu.detector import AnomalyType
 from cruise_control_tpu.facade import CruiseControl, OperationResult
@@ -78,6 +90,15 @@ READINESS_GATED = {
     "FIX_OFFLINE_REPLICAS", "TOPIC_CONFIGURATION", "RIGHTSIZE",
     "REMOVE_DISKS", "SIMULATE", "PROPOSALS",
 }
+#: REBALANCE-family endpoints that, with the backend circuit breaker OPEN,
+#: degrade to the journaled standing proposal set (marked ``degraded=true``)
+#: instead of queueing a solve behind a dead backend — the continuous-
+#: reconfiguration posture (arxiv 1602.03770): keep answering from the warm
+#: standing state while the world is on fire
+BREAKER_DEGRADED = {
+    "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
+    "FIX_OFFLINE_REPLICAS", "PROPOSALS",
+}
 
 
 class ReadinessState:
@@ -99,14 +120,50 @@ class ReadinessController:
     explicit phases are set by the app shell.  Every transition is appended
     to ``history`` so a post-hoc probe can verify the whole ladder ran."""
 
-    def __init__(self, monitor_probe=None, start_ready: bool = False) -> None:
+    def __init__(
+        self,
+        monitor_probe=None,
+        start_ready: bool = False,
+        retry_after_default_s: int = 5,
+        warming_hint_s: Optional[float] = None,
+    ) -> None:
         self.monitor_probe = monitor_probe
         self._lock = threading.Lock()
         self._phase = ReadinessState.READY if start_ready else ReadinessState.STARTING
         self.history: List[Tuple[str, float]] = [(self._phase, time.time())]
         #: recovery accounting surfaced by /healthz and STATE (set by the app)
         self.recovery: Dict[str, object] = {}
+        #: Retry-After floor/fallback for not-ready 503s (retry.after.default.s)
+        self.retry_after_default_s = max(int(retry_after_default_s), 1)
+        #: expected seconds until the monitor can complete a window (the app
+        #: shell passes the sampling interval) — the warming-rung estimate
+        self.warming_hint_s = warming_hint_s
         self._export_gauge()
+
+    def retry_after_s(self) -> int:
+        """Retry-After for a not-ready 503, derived from where the ladder
+        actually is instead of a hardcoded constant:
+
+        * ``recovering`` — a replay that has already run *T* seconds is, to a
+          first order, about half-way through (the doubling estimate), so the
+          suggestion is ~*T* more, floored at the default and capped at 60 s
+          so a pathological recovery can't tell clients to go away for hours.
+        * ``monitor_warming`` — the monitor cannot become ready before its
+          next sampling pass lands, so the suggestion is the sampling
+          interval (``warming_hint_s``), capped at 300 s; default without a
+          hint.
+        * anything else (starting, or a race with ready) — the default."""
+        with self._lock:
+            phase = self._phase
+            entered = self.history[-1][1] if self.history else time.time()
+        if phase == ReadinessState.RECOVERING:
+            elapsed = max(time.time() - entered, 0.0)
+            return int(
+                min(max(elapsed, self.retry_after_default_s), 60.0) + 0.999
+            )
+        if phase == ReadinessState.MONITOR_WARMING and self.warming_hint_s:
+            return int(min(max(self.warming_hint_s, 1.0), 300.0) + 0.999)
+        return self.retry_after_default_s
 
     def _export_gauge(self) -> None:
         from cruise_control_tpu.core.sensors import READY_GAUGE, REGISTRY
@@ -253,6 +310,9 @@ class CruiseControlApp:
         readiness: Optional[ReadinessController] = None,
         user_task_journal=None,
         controller=None,
+        admission: Optional[AdmissionController] = None,
+        breaker=None,
+        max_active_user_tasks: int = 25,
     ) -> None:
         self.cc = cruise_control
         self.anomaly_manager = anomaly_manager
@@ -265,7 +325,15 @@ class CruiseControlApp:
         # embedded/test construction defaults to always-ready; the app shell
         # passes its real readiness ladder
         self.readiness = readiness or ReadinessController(start_ready=True)
-        self.user_tasks = UserTaskManager(journal=user_task_journal)
+        #: admission controller (api/admission.py): every authenticated
+        #: request passes it; permissive defaults when not configured
+        self.admission = admission or AdmissionController()
+        #: shared backend circuit breaker (backend/breaker.py), None = no
+        #: breaker on this seam (embedded/test construction)
+        self.breaker = breaker
+        self.user_tasks = UserTaskManager(
+            journal=user_task_journal, max_active_tasks=max_active_user_tasks
+        )
         self.purgatory = Purgatory()
         self.proposal_cache_ttl_s = proposal_cache_ttl_s
         self._proposal_cache: Optional[Tuple[float, dict]] = None
@@ -327,6 +395,10 @@ class CruiseControlApp:
         body["Profiler"] = PROFILER.snapshot()
         # readiness ladder + recovery accounting (journal replay, wall)
         body["Readiness"] = self.readiness.snapshot()
+        # overload plane: admission accounting + breaker state machine
+        body["Admission"] = self.admission.snapshot()
+        if self.breaker is not None:
+            body["Breaker"] = self.breaker.snapshot()
         # continuous control loop: drift, standing set, reaction latency
         if self.controller is not None:
             body["Controller"] = self.controller.status()
@@ -515,21 +587,43 @@ class CruiseControlApp:
         from cruise_control_tpu.obs import recorder as obs
 
         key = (endpoint, tuple(sorted((k, tuple(v)) for k, v in params.items())))
+        # admission (api/admission.py): a dedupe hit rides its existing task
+        # and consumes NO quota or queue capacity (re-POST is the reference's
+        # poll idiom); a miss acquires an execution slot, waiting in the
+        # bounded priority queue when all slots are busy — bounded by the
+        # queue timeout AND the request's own deadline_ms budget, so an
+        # over-deadline request sheds here, before it ever reaches the solver
+        ticket = None
+        if self.user_tasks.peek(key) is None:
+            ctx = adm.current_request_context()
+            ticket = self.admission.acquire(
+                ctx.principal if ctx else adm.ANONYMOUS_PRINCIPAL,
+                endpoint,
+                role=ctx.role if ctx else None,
+                anonymous=ctx.anonymous if ctx else True,
+                deadline_s=ctx.remaining_s() if ctx else None,
+            )
+        else:
+            self.admission.note_dedupe_hit()
         # the request id in scope (handle() opened it) rides into the task so
         # the pool thread's traces correlate; a deduped resubmission keeps the
         # first request's id — the task is one operation, whoever polls it.
         # The formatter goes in WITH the work (not assigned afterwards): the
         # journal embeds the serialized result in the completion record, and
-        # a fast task can finish before this function's next statement
+        # a fast task can finish before this function's next statement.  The
+        # ticket's release is owned by get_or_create from here on (dedupe
+        # race, refused creation, completion).
         task = self.user_tasks.get_or_create(
             endpoint, key, work, parent_id=obs.current_parent_id(),
-            result_to_json=to_json,
+            result_to_json=to_json, admission_ticket=ticket,
         )
         headers = {"User-Task-ID": task.task_id}
         if task.status in (TaskStatus.COMPLETED, TaskStatus.COMPLETED_WITH_ERROR):
             try:
                 result = task.future.result(timeout=0)
                 return 200, to_json(result), headers
+            except (AdmissionRefused, TooManyUserTasksError):
+                raise   # shed inside the work: surfaces as 429, never a 500
             except Exception as e:
                 return 500, {"error": str(e), "progress": task.progress.to_list()}, headers
         # wait briefly so fast operations answer synchronously (reference's
@@ -537,6 +631,8 @@ class CruiseControlApp:
         try:
             result = task.future.result(timeout=1.0)
             return 200, to_json(result), headers
+        except (AdmissionRefused, TooManyUserTasksError):
+            raise
         except Exception:
             pass
         return 202, {"progress": task.progress.to_list(), "userTaskId": task.task_id}, headers
@@ -546,12 +642,25 @@ class CruiseControlApp:
         goal_ids = _goal_ids(params)
         excluded = params.get("excluded_topics", [None])[0]
         excluded_topics = excluded.split(",") if excluded else ()
+        # the client budget (deadline_ms) follows the request into the solver:
+        # whatever the admission queue didn't spend becomes this request's
+        # optimize.deadline.ms, so a tight-budget solve returns best-so-far
+        # degraded=true instead of overrunning
+        ctx = adm.current_request_context()
 
         def work(progress):
             progress.add_step("WaitingForClusterModel")
             progress.add_step("OptimizationForGoals")
+            deadline_s = ctx.remaining_s() if ctx is not None else None
+            if deadline_s is not None and deadline_s <= 0:
+                # accounted shed (counters + trace), same as every other path
+                self.admission.shed_deadline(
+                    ctx.principal, "REBALANCE",
+                    "REBALANCE: client budget exhausted before the solve",
+                )
             return self.cc.rebalance(
-                dryrun=dryrun, goal_ids=goal_ids, excluded_topics=excluded_topics
+                dryrun=dryrun, goal_ids=goal_ids,
+                excluded_topics=excluded_topics, deadline_s=deadline_s,
             )
 
         return self._async_op("REBALANCE", params, work)
@@ -754,7 +863,11 @@ class CruiseControlApp:
         # credentials) and expose only the readiness ladder, never cluster data
         if method == "GET" and endpoint == "HEALTHZ":
             status, body = self.get_healthz(params)
-            headers_out = {} if status != 503 else {"Retry-After": "5"}
+            headers_out = {} if status != 503 else {
+                # derived from recovery/warming progress, not a constant — a
+                # probe told "5" during a 10-minute replay just burns probes
+                "Retry-After": str(self.readiness.retry_after_s())
+            }
             return status, body, headers_out
 
         try:
@@ -765,18 +878,112 @@ class CruiseControlApp:
         if not self.security.authorize(role, endpoint, method):
             return 403, {"error": f"role {role.name} may not {method} {endpoint}"}, {}
 
+        # request context for the admission layer: principal (security.py
+        # user; anonymous under NoSecurityProvider), tier role, and the
+        # client budget (deadline_ms) that bounds queue wait AND becomes the
+        # per-request optimize deadline.  A malformed budget is a 400 HTTP
+        # answer, never an unhandled exception — the socket must always
+        # carry a response (the same contract the deep listen backlog keeps)
+        deadline_ms = params.get("deadline_ms", [None])[0]
+        deadline_mono = None
+        if deadline_ms:
+            try:
+                budget_ms = int(deadline_ms)
+                if budget_ms <= 0:
+                    raise ValueError(deadline_ms)
+            except ValueError:
+                return (
+                    400,
+                    {"error": f"deadline_ms must be a positive integer, "
+                              f"got {deadline_ms!r}"},
+                    {},
+                )
+            deadline_mono = time.monotonic() + budget_ms / 1000.0
+        ctx = RequestContext(
+            principal=principal_of(user),
+            role=role,
+            anonymous=user is None,
+            deadline_mono=deadline_mono,
+        )
         request_id = headers.get("X-Request-Id") or f"req-{uuid.uuid4().hex[:16]}"
-        with obs.parent_scope(request_id):
-            status, body, out_headers = self._dispatch_authorized(
-                method, endpoint, params, user, role
-            )
+        ctx_token = adm.set_request_context(ctx)
+        try:
+            with obs.parent_scope(request_id):
+                status, body, out_headers = self._dispatch_authorized(
+                    method, endpoint, params, user, role
+                )
+        finally:
+            adm.reset_request_context(ctx_token)
         out_headers = dict(out_headers)
         out_headers.setdefault("X-Request-Id", request_id)
         return status, body, out_headers
 
+    def _retry_after_header(self, seconds: float) -> Dict[str, str]:
+        return {"Retry-After": str(max(int(seconds + 0.999), 1))}
+
+    def _degraded_standing(self, endpoint: str) -> Tuple[int, dict, Dict[str, str]]:
+        """Breaker-open answer for REBALANCE-family requests: the journaled
+        standing proposal set (controller/standing.py) marked
+        ``degraded=true`` — the best placement knowledge the control plane
+        has, served warm instead of queueing a solve behind a dead backend.
+        Without a standing set the honest answer is 503 + Retry-After (the
+        breaker's next probe window)."""
+        retry_s = max(
+            self.breaker.retry_after_s() if self.breaker is not None else 0.0,
+            1.0,
+        )
+        standing = self.controller.standing if self.controller is not None else None
+        if standing is None:
+            return (
+                503,
+                {
+                    "error": (
+                        f"{endpoint}: backend unavailable (circuit breaker "
+                        "open) and no standing proposal set to degrade to"
+                    ),
+                    "breakerOpen": True,
+                },
+                self._retry_after_header(retry_s),
+            )
+        return (
+            200,
+            {
+                "degraded": True,
+                "breakerOpen": True,
+                "standingVersion": standing.version,
+                "trigger": standing.trigger,
+                "createdMs": standing.created_ms,
+                "proposals": [
+                    {
+                        "topic": p.tp[0],
+                        "partition": p.tp[1],
+                        "oldLeader": p.old_leader,
+                        "oldReplicas": list(p.old_replicas),
+                        "newReplicas": list(p.new_replicas),
+                    }
+                    for p in standing.proposals[:1000]
+                ],
+                "numProposals": len(standing.proposals),
+            },
+            self._retry_after_header(retry_s),
+        )
+
     def _dispatch_authorized(
         self, method: str, endpoint: str, params: Dict[str, List[str]], user, role
     ) -> Tuple[int, Union[dict, str], Dict[str, str]]:
+        # admission: the token bucket is the first, cheapest refusal — it
+        # must fire before any readiness/breaker/model work (overload
+        # protection that itself does work per request protects nothing).
+        # Cheap reads and operator escape hatches bypass (admission.py).
+        if endpoint not in CHEAP_ENDPOINTS:
+            try:
+                self.admission.check_rate(principal_of(user), endpoint)
+            except AdmissionRefused as e:
+                return (
+                    429,
+                    {"error": str(e), "reason": e.reason},
+                    self._retry_after_header(e.retry_after_s),
+                )
         if endpoint in READINESS_GATED and not self.readiness.is_ready:
             # optimize-family requests are refused, not queued, until the
             # readiness ladder completes — a solve against a recovering
@@ -788,8 +995,17 @@ class CruiseControlApp:
                     "error": f"not ready: {phase}; retry after readiness",
                     "readiness": phase,
                 },
-                {"Retry-After": "5"},
+                {"Retry-After": str(self.readiness.retry_after_s())},
             )
+        if (
+            endpoint in BREAKER_DEGRADED
+            and self.breaker is not None
+            and self.breaker.is_open
+        ):
+            # a dead backend must not accumulate queued solves: answer from
+            # the warm standing state, marked degraded, and tell the client
+            # when the breaker will probe again
+            return self._degraded_standing(endpoint)
         try:
             if method == "GET":
                 if endpoint == "PERMISSIONS":
@@ -821,6 +1037,22 @@ class CruiseControlApp:
             if fn is None:
                 return 404, {"error": f"unknown endpoint {endpoint}"}, {}
             return fn(params)
+        except AdmissionRefused as e:
+            # load shed: a real 429 with a Retry-After derived from queue
+            # depth and drain rate — never a 500
+            return (
+                429,
+                {"error": str(e), "reason": e.reason},
+                self._retry_after_header(e.retry_after_s),
+            )
+        except TooManyUserTasksError as e:
+            # the user-task cap is the admission queue's backstop; crossing
+            # it is still overload, not a server fault
+            return (
+                429,
+                {"error": str(e), "reason": "max-active-tasks"},
+                self._retry_after_header(self.admission.retry_after_estimate()),
+            )
         except Exception as e:  # uniform error envelope (reference's error response)
             return 500, {"error": f"{type(e).__name__}: {e}"}, {}
 
@@ -875,6 +1107,15 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
+class _Server(ThreadingHTTPServer):
+    # the stdlib default listen backlog is 5: under a concurrent-client burst
+    # the kernel refuses the 6th SYN while the accept loop is busy, which
+    # surfaces as a connection reset — a shed without a 429, exactly what the
+    # admission layer exists to prevent.  Deepen the backlog so overload is
+    # always answered by admission control, never by the kernel.
+    request_queue_size = 512
+
+
 def make_server(app: CruiseControlApp, host: str = "127.0.0.1", port: int = 9090):
     handler = type("BoundHandler", (_Handler,), {"app": app})
-    return ThreadingHTTPServer((host, port), handler)
+    return _Server((host, port), handler)

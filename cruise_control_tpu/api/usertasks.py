@@ -37,6 +37,21 @@ class TaskStatus(enum.Enum):
     COMPLETED_WITH_ERROR = "CompletedWithError"
 
 
+class TooManyUserTasksError(RuntimeError):
+    """The active-task cap is reached.  A ``RuntimeError`` subclass (the
+    pre-overload-plane type) so existing callers keep catching it, but typed
+    so the API layer can map it to ``429`` + ``Retry-After`` instead of
+    letting it escape as a 500 — overload is the *client's* signal to back
+    off, not a server fault."""
+
+    def __init__(self, active: int, cap: int) -> None:
+        super().__init__(
+            f"too many active user tasks ({active} active, cap {cap})"
+        )
+        self.active = active
+        self.cap = cap
+
+
 @dataclasses.dataclass
 class UserTask:
     task_id: str
@@ -194,6 +209,22 @@ class UserTaskManager:
             self.recovered_tasks += 1
             REGISTRY.counter(USER_TASKS_RECOVERED_COUNTER).inc()
 
+    def peek(self, request_key: Tuple) -> Optional[UserTask]:
+        """The task already registered for this request key, if any — the
+        admission layer's dedupe pre-check (a re-submitted request rides its
+        existing task and must not consume quota or queue capacity).
+
+        Expires first: a key whose retained task just aged out must read as
+        a MISS, or the caller would skip admission while ``get_or_create``
+        (which also expires) goes on to create a brand-new unticketed task —
+        a solve running outside every slot and quota."""
+        with self._lock:
+            self._expire_locked()
+            existing_id = self._by_key.get(request_key)
+            if existing_id:
+                return self._tasks.get(existing_id)
+            return None
+
     def get_or_create(
         self,
         endpoint: str,
@@ -201,6 +232,7 @@ class UserTaskManager:
         work: Callable[[OperationProgress], object],
         parent_id: Optional[str] = None,
         result_to_json: Optional[Callable[[object], dict]] = None,
+        admission_ticket=None,
     ) -> UserTask:
         """Dedupe by request key: re-submitting the same request returns the same
         task (getOrCreateUserTask:222's session semantics, keyed by parameters).
@@ -209,18 +241,29 @@ class UserTaskManager:
         id links the task to every optimize/execution trace it caused.
         ``result_to_json`` must be passed HERE (not assigned after the fact)
         when the journal is on: the completion record embeds the serialized
-        result, and the worker may finish before the caller's next statement."""
+        result, and the worker may finish before the caller's next statement.
+        ``admission_ticket`` (api/admission.py) is released when the task
+        completes — or immediately on a dedupe hit / refused creation, so a
+        request that created no work never holds an execution slot."""
         with self._lock:
             self._expire_locked()
             existing_id = self._by_key.get(request_key)
             if existing_id and existing_id in self._tasks:
+                # dedupe hit: no new work — the caller's admission slot (won
+                # in a race against the thread that actually created the
+                # task) must be handed back, not leaked until "completion"
+                # of a task it doesn't own
+                if admission_ticket is not None:
+                    admission_ticket.release()
                 return self._tasks[existing_id]
             active = sum(
                 1 for t in self._tasks.values()
                 if t.status in (TaskStatus.ACTIVE, TaskStatus.IN_EXECUTION)
             )
             if active >= self.max_active_tasks:
-                raise RuntimeError("too many active user tasks")
+                if admission_ticket is not None:
+                    admission_ticket.release()
+                raise TooManyUserTasksError(active, self.max_active_tasks)
             task_id = str(uuid.uuid4())
             progress = OperationProgress()
             task = UserTask(
@@ -258,6 +301,8 @@ class UserTaskManager:
                 except Exception:
                     self._tasks.pop(task_id, None)
                     self._by_key.pop(request_key, None)
+                    if admission_ticket is not None:
+                        admission_ticket.release()
                     raise
 
         def _run():
@@ -281,6 +326,11 @@ class UserTaskManager:
                 finally:
                     progress.complete()
                     self._journal_finished(task, result, error)
+                    if admission_ticket is not None:
+                        # the slot frees when the WORK ends, not when the HTTP
+                        # response goes out — admission gates solver
+                        # concurrency, and a 202'd task is still running
+                        admission_ticket.release()
                     obs.finish_trace(
                         token,
                         attrs={
@@ -290,7 +340,16 @@ class UserTaskManager:
                         },
                     )
 
-        task.future = self._pool.submit(_run)
+        try:
+            task.future = self._pool.submit(_run)
+        except RuntimeError:
+            # pool shut down mid-request: unregister and hand the slot back
+            with self._lock:
+                self._tasks.pop(task_id, None)
+                self._by_key.pop(request_key, None)
+            if admission_ticket is not None:
+                admission_ticket.release()
+            raise
         return task
 
     def _journal_finished(self, task: UserTask, result, error: Optional[str]) -> None:

@@ -174,6 +174,25 @@ class CruiseControlTpuApp:
                         replication_factor=cfg.get("demo.cluster.replication.factor"),
                     )
                     self._demo_backend = True
+
+        # backend circuit breaker (breaker.enable): ONE shared breaker guards
+        # every southbound seam — monitor sampling, executor, detectors,
+        # controller all see the same open/closed state, so a blackout fails
+        # fast everywhere instead of stacking each caller in its own retry
+        # backoff.  Wrapped BEFORE anything captures the backend reference.
+        self.breaker = None
+        if cfg.get("breaker.enable"):
+            from cruise_control_tpu.backend.breaker import (
+                BreakerBackend,
+                CircuitBreaker,
+            )
+
+            self.breaker = CircuitBreaker(
+                failure_threshold=cfg.get("breaker.failure.threshold"),
+                open_s=cfg.get("breaker.open.ms") / 1000.0,
+                max_open_s=cfg.get("breaker.max.open.ms") / 1000.0,
+            )
+            backend = BreakerBackend(backend, self.breaker)
         self.backend = backend
 
         sampler_cls = resolve_class(cfg.get("metric.sampler.class"))
@@ -272,7 +291,13 @@ class CruiseControlTpuApp:
             except Exception:
                 return False
 
-        self.readiness = ReadinessController(monitor_probe=_monitor_warm)
+        self.readiness = ReadinessController(
+            monitor_probe=_monitor_warm,
+            retry_after_default_s=cfg.get("retry.after.default.s"),
+            # the warming rung cannot end before the next sampling pass
+            # completes a window — that interval IS the honest Retry-After
+            warming_hint_s=cfg.get("metric.sampling.interval.ms") / 1000.0,
+        )
 
         # continuous control loop (controller.enable): streaming drift-
         # triggered incremental rebalancing with a durable standing proposal
@@ -293,6 +318,7 @@ class CruiseControlTpuApp:
             self.controller = ContinuousController(
                 self.cruise_control,
                 journal=controller_journal,
+                breaker=self.breaker,
                 config=ControllerConfig(
                     tick_interval_s=cfg.get("controller.tick.interval.ms") / 1000.0,
                     drift_threshold=cfg.get("controller.drift.threshold"),
@@ -367,6 +393,31 @@ class CruiseControlTpuApp:
             # first detection waits a full interval after every restart
             initial_pass=cfg.get("anomaly.detection.initial.pass"),
             ready_probe=lambda: self.readiness.is_ready,
+            # while the breaker is open a pass is skipped with a counted
+            # reason — one outage must not read as a storm of anomalies
+            breaker=self.breaker,
+        )
+
+        # admission controller (admission.enable): rate limits, per-principal
+        # quotas, and the bounded priority queue in front of the user-task
+        # plane.  max_concurrent defaults to the user-task active cap, so the
+        # queue fills exactly when the task table would have 500'd before.
+        from cruise_control_tpu.api.admission import (
+            AdmissionConfig,
+            AdmissionController,
+        )
+
+        self.admission = AdmissionController(
+            AdmissionConfig(
+                enabled=cfg.get("admission.enable"),
+                rate_qps=cfg.get("admission.rate.limit.qps"),
+                rate_burst=cfg.get("admission.rate.burst"),
+                max_tasks_per_principal=cfg.get("admission.max.tasks.per.principal"),
+                max_concurrent=cfg.get("max.active.user.tasks"),
+                queue_capacity=cfg.get("admission.queue.capacity"),
+                queue_timeout_s=cfg.get("admission.queue.timeout.ms") / 1000.0,
+                default_retry_after_s=cfg.get("retry.after.default.s"),
+            )
         )
         self.app = CruiseControlApp(
             self.cruise_control,
@@ -378,6 +429,12 @@ class CruiseControlTpuApp:
             readiness=self.readiness,
             user_task_journal=self._user_task_journal,
             controller=self.controller,
+            admission=self.admission,
+            breaker=self.breaker,
+            # max.active.user.tasks was defined but never wired pre-overload-
+            # plane: the task table cap and the admission slot count now both
+            # come from the one knob
+            max_active_user_tasks=cfg.get("max.active.user.tasks"),
         )
         self._server = None
         self._sampling_thread: Optional[threading.Thread] = None

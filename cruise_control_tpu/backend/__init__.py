@@ -18,6 +18,11 @@ from cruise_control_tpu.backend.base import (
     RawMetric,
     ReassignmentInProgress,
 )
+from cruise_control_tpu.backend.breaker import (
+    BreakerBackend,
+    BreakerOpenError,
+    CircuitBreaker,
+)
 from cruise_control_tpu.backend.chaos import (
     ChaosBackend,
     ChaosInjectedError,
@@ -27,9 +32,12 @@ from cruise_control_tpu.backend.chaos import (
 from cruise_control_tpu.backend.fake import FakeClusterBackend
 
 __all__ = [
+    "BreakerBackend",
+    "BreakerOpenError",
     "BrokerInfo",
     "ChaosBackend",
     "ChaosInjectedError",
+    "CircuitBreaker",
     "SimulatedCrash",
     "ClusterBackend",
     "ClusterDescription",

@@ -59,7 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
         "traces", help="flight-recorder records, filterable by correlation id"
     )
     tr.add_argument("--kind", default=None,
-                    help="optimize | execution | user_task | simulate | ...")
+                    help="optimize | execution | user_task | simulate | "
+                         "admission | ...")
     tr.add_argument("--trace-id", default=None)
     tr.add_argument("--parent-id", default=None,
                     help="X-Request-Id: walks request -> task -> optimize -> execution")
@@ -78,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
             p.add_argument("--excluded-topics", default=None)
             p.add_argument("--request-id", default=None,
                            help="X-Request-Id to correlate the operation's traces")
+            p.add_argument("--deadline-ms", type=int, default=None,
+                           help="client budget: bounds the admission-queue "
+                                "wait (over-deadline = 429 + Retry-After) "
+                                "and the solve itself (expiry returns "
+                                "best-so-far marked degraded=true)")
         if name == "rightsize":
             p.add_argument("--load-factor", type=float, default=None,
                            help="plan capacity for current load × this factor")
@@ -164,7 +170,8 @@ def main(argv=None) -> int:
             goals = args.goals.split(",") if args.goals else None
             out = client.rebalance(dryrun=args.dryrun, goals=goals,
                                    excluded_topics=args.excluded_topics, wait=wait,
-                                   request_id=args.request_id)
+                                   request_id=args.request_id,
+                                   deadline_ms=args.deadline_ms)
         elif ep in ("add_broker", "remove_broker", "demote_broker"):
             out = getattr(client, ep)(_int_list(args.brokers), dryrun=args.dryrun, wait=wait)
         elif ep == "fix_offline_replicas":
@@ -205,7 +212,11 @@ def main(argv=None) -> int:
         else:  # pragma: no cover - argparse guards
             raise SystemExit(2)
     except ClientError as e:
-        print(json.dumps({"status": e.status, "error": e.body}, indent=2), file=sys.stderr)
+        err = {"status": e.status, "error": e.body}
+        if e.retry_after_s is not None:
+            # shed (429) / not-ready (503): surface the server's backoff hint
+            err["retryAfterS"] = e.retry_after_s
+        print(json.dumps(err, indent=2), file=sys.stderr)
         return 1
     print(json.dumps(out, indent=2, default=str))
     return 0

@@ -19,12 +19,15 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 
 class ClientError(Exception):
-    """Non-2xx response; carries the HTTP status and decoded body."""
+    """Non-2xx response; carries the HTTP status, decoded body, and — for
+    429/503 shed/not-ready answers — the server's ``Retry-After`` seconds
+    (``retry_after_s``, None when the header was absent)."""
 
-    def __init__(self, status: int, body: Any):
+    def __init__(self, status: int, body: Any, retry_after_s: Optional[float] = None):
         super().__init__(f"HTTP {status}: {body}")
         self.status = status
         self.body = body
+        self.retry_after_s = retry_after_s
 
 
 class CruiseControlClient:
@@ -83,7 +86,11 @@ class CruiseControlClient:
             except json.JSONDecodeError:
                 body = {"raw": data.decode(errors="replace")}
             if e.code >= 400:
-                raise ClientError(e.code, body) from None
+                retry_after = e.headers.get("Retry-After")
+                raise ClientError(
+                    e.code, body,
+                    retry_after_s=float(retry_after) if retry_after else None,
+                ) from None
             return e.code, body, dict(e.headers)
 
     def _get(self, endpoint: str, **params) -> Any:
@@ -233,14 +240,20 @@ class CruiseControlClient:
         excluded_topics: Optional[str] = None,
         wait: bool = True,
         request_id: Optional[str] = None,
+        deadline_ms: Optional[int] = None,
     ) -> Any:
         """``request_id`` rides the ``X-Request-Id`` header: every trace the
         rebalance causes (user task, optimize, execution) carries it as
-        ``parent_id`` — retrieve the whole story with :meth:`traces`."""
+        ``parent_id`` — retrieve the whole story with :meth:`traces`.
+        ``deadline_ms`` is the client budget: it bounds the server-side
+        admission-queue wait (over-deadline ⇒ 429 + Retry-After, raised here
+        as :class:`ClientError`) and becomes the per-request optimize
+        deadline (an expiring solve returns ``degraded=true`` best-so-far)."""
         return self._post(
             "rebalance", wait=wait, request_id=request_id,
             dryrun=str(dryrun).lower(),
             goals=self._csv(goals), excluded_topics=excluded_topics,
+            deadline_ms=deadline_ms,
         )
 
     def add_broker(self, broker_ids: Sequence[int], dryrun: bool = True, wait: bool = True) -> Any:

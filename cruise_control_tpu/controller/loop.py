@@ -87,10 +87,15 @@ class ContinuousController:
         cruise_control,
         journal: Optional[ControllerJournal] = None,
         config: Optional[ControllerConfig] = None,
+        breaker=None,
     ) -> None:
         self.cc = cruise_control
         self.journal = journal
         self.cfg = config or ControllerConfig()
+        #: shared backend circuit breaker: while open the loop holds position
+        #: — no ticks, no rebuilds, standing set stays published (the
+        #: degraded REBALANCE answers are served from it)
+        self.breaker = breaker
         self._optimizer = GoalOptimizer(
             goal_ids=cruise_control.goal_ids,
             hard_ids=cruise_control.hard_ids,
@@ -399,6 +404,19 @@ class ContinuousController:
 
         with self._tick_lock:
             self._update_staleness_gauge()
+            if self.breaker is not None and self.breaker.is_open:
+                # backend blackout: hold position (counted), pause or not.
+                # The standing set keeps standing — it is what degraded
+                # REBALANCE answers serve — and ticking (even a forced one)
+                # would only fail fast against the open breaker and thrash
+                # the drift baseline
+                from cruise_control_tpu.core.sensors import (
+                    CONTROLLER_BREAKER_SKIPS_COUNTER,
+                    REGISTRY,
+                )
+
+                REGISTRY.counter(CONTROLLER_BREAKER_SKIPS_COUNTER).inc()
+                return None
             if self.paused:
                 return None
             if not self.warmed or self._needs_rebuild:
@@ -759,6 +777,12 @@ class ContinuousController:
             "paused": self.paused,
             "pauseReason": self.pause_reason,
             "warmed": self.warmed,
+            # backend blackout flag: the loop is holding position behind the
+            # open breaker; the standing set below is what degraded
+            # REBALANCE-family answers are served from
+            "breakerOpen": (
+                self.breaker.is_open if self.breaker is not None else False
+            ),
             "stalenessS": round(staleness, 3),
             # no fresh window delta for longer than the stale budget: the
             # loop is flying blind (e.g. a reporter-feed outage) — it stops

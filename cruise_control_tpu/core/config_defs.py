@@ -241,6 +241,64 @@ def controller_config() -> ConfigDef:
     return d
 
 
+def admission_config() -> ConfigDef:
+    """Overload-resilient serving plane (api/admission.py + backend/breaker.py
+    — TPU-specific, no reference counterpart): admission control, per-principal
+    quotas, priority queueing, and the backend circuit breaker."""
+    d = ConfigDef()
+    d.define("admission.enable", Type.BOOLEAN, True, H,
+             "Pass every authenticated request through the admission "
+             "controller: per-principal token-bucket rate limits, active-"
+             "operation quotas, and a global bounded priority queue feeding "
+             "the user-task plane.  Rejected work gets 429 + Retry-After "
+             "(derived from queue depth and drain rate), never a 500.")
+    d.define("admission.rate.limit.qps", Type.DOUBLE, 0.0, M,
+             "Per-principal request rate (token bucket refill, requests/s) "
+             "on non-cheap endpoints; 0 = unlimited.  Cheap reads "
+             "(STATE/METRICS/HEALTHZ/TRACES/...) and operator escape hatches "
+             "always bypass.", in_range(lo=0.0))
+    d.define("admission.rate.burst", Type.DOUBLE, 0.0, L,
+             "Token-bucket depth (burst allowance); 0 = max(2 x qps, 1).",
+             in_range(lo=0.0))
+    d.define("admission.max.tasks.per.principal", Type.INT, 0, M,
+             "Per-principal cap on concurrently in-flight solver operations "
+             "(REBALANCE family, SIMULATE, RIGHTSIZE); 0 = no quota.  A "
+             "principal at its quota is shed with 429 immediately — queueing "
+             "it would let one tenant starve the rest.", in_range(lo=0))
+    d.define("admission.queue.capacity", Type.INT, 64, M,
+             "Bound of the global priority queue solver-class requests wait "
+             "in when all execution slots are busy; arrivals past it shed "
+             "instantly with 429 + Retry-After.", in_range(lo=1))
+    d.define("admission.queue.timeout.ms", Type.LONG, 5_000, M,
+             "Longest a queued request waits for an execution slot before "
+             "shedding (also bounded by the request's own deadline_ms "
+             "budget — an over-deadline queued request never reaches the "
+             "solver).", in_range(lo=1))
+    d.define("retry.after.default.s", Type.INT, 5, L,
+             "Retry-After fallback (seconds) for 429/503 responses when no "
+             "better estimate exists yet (no observed drain rate, "
+             "zero-progress recovery).", in_range(lo=1))
+    d.define("breaker.enable", Type.BOOLEAN, True, H,
+             "Guard every southbound backend call with a shared circuit "
+             "breaker (closed -> open -> half-open): after "
+             "breaker.failure.threshold consecutive failures callers fail "
+             "fast instead of stacking in retry backoff; deterministic "
+             "seeded probes close it again.  While open, detectors skip "
+             "their pass (counted), the controller holds position, and "
+             "REBALANCE-family requests degrade to the journaled standing "
+             "proposal set marked degraded=true.")
+    d.define("breaker.failure.threshold", Type.INT, 5, M,
+             "Consecutive southbound failures that open the breaker (any "
+             "success resets the streak).", in_range(lo=1))
+    d.define("breaker.open.ms", Type.LONG, 10_000, M,
+             "Cooldown before the first half-open probe; doubles per failed "
+             "probe (seeded jitter) up to breaker.max.open.ms.",
+             in_range(lo=1))
+    d.define("breaker.max.open.ms", Type.LONG, 60_000, L,
+             "Ceiling of the probe-backoff cooldown.", in_range(lo=1))
+    return d
+
+
 def anomaly_detector_config() -> ConfigDef:
     """AnomalyDetectorConfig.java — detection cadence, self-healing, notifier."""
     d = ConfigDef()
@@ -327,6 +385,7 @@ def cruise_control_config() -> ConfigDef:
         analyzer_config(),
         executor_config(),
         controller_config(),
+        admission_config(),
         anomaly_detector_config(),
         webserver_config(),
     ):

@@ -227,3 +227,26 @@ CONTROLLER_STANDING_VERSION_GAUGE = "Controller.standing-version"
 CONTROLLER_STANDING_PROPOSALS_GAUGE = "Controller.standing-proposals"
 CONTROLLER_STALENESS_GAUGE = "Controller.staleness-seconds"
 CONTROLLER_REBUILDS_COUNTER = "Controller.topology-rebuilds"
+CONTROLLER_BREAKER_SKIPS_COUNTER = "Controller.breaker-open-skips"
+# overload plane (api/admission.py): every authenticated request passes the
+# admission controller — sheds are the load-shedding contract (429 +
+# Retry-After, never a 500), accounted by reason
+ADMISSION_ADMITTED_COUNTER = "Admission.admitted"
+ADMISSION_SHED_COUNTER = "Admission.shed"
+ADMISSION_SHED_RATE_COUNTER = "Admission.shed-rate-limited"
+ADMISSION_SHED_QUOTA_COUNTER = "Admission.shed-principal-quota"
+ADMISSION_SHED_QUEUE_FULL_COUNTER = "Admission.shed-queue-full"
+ADMISSION_SHED_DEADLINE_COUNTER = "Admission.shed-deadline"
+ADMISSION_QUEUED_COUNTER = "Admission.queued"
+ADMISSION_DEDUPE_HITS_COUNTER = "Admission.dedupe-hits"
+ADMISSION_QUEUE_DEPTH_GAUGE = "Admission.queue-depth"
+ADMISSION_ACTIVE_GAUGE = "Admission.active-operations"
+ADMISSION_WAIT_TIMER = "Admission.queue-wait-timer"
+ADMISSION_DRAIN_METER = "Admission.drain-rate"
+# backend circuit breaker (backend/breaker.py)
+BREAKER_OPENS_COUNTER = "CircuitBreaker.opens"
+BREAKER_CLOSES_COUNTER = "CircuitBreaker.closes"
+BREAKER_PROBES_COUNTER = "CircuitBreaker.probes"
+BREAKER_FAST_FAILURES_COUNTER = "CircuitBreaker.fast-failures"
+BREAKER_STATE_GAUGE = "CircuitBreaker.state"      # 0 closed, 1 half-open, 2 open
+DETECTOR_BREAKER_SKIPS_COUNTER = "AnomalyDetector.passes-skipped-breaker-open"

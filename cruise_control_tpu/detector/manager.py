@@ -48,6 +48,7 @@ class AnomalyDetectorManager:
         history_limit: int = 10,
         initial_pass: bool = False,
         ready_probe=None,
+        breaker=None,
     ) -> None:
         """``detectors``: (detector, interval_s) pairs (the reference schedules 5
         periodic detectors + 1 continuous, :234-243).
@@ -58,13 +59,21 @@ class AnomalyDetectorManager:
         that died during the restart window would otherwise go unnoticed for
         up to a whole cadence (``anomaly.detection.initial.pass``; the app
         shell passes the readiness ladder as the probe so the pass never
-        races journal recovery or an unwarmed monitor)."""
+        races journal recovery or an unwarmed monitor).
+
+        ``breaker`` is the shared backend circuit breaker
+        (:class:`~cruise_control_tpu.backend.breaker.CircuitBreaker`): while
+        it is open a detection pass is *skipped with a counted reason*
+        instead of run — every detector's first act is a southbound call that
+        would fail fast anyway, and a failed pass against a blacked-out
+        backend reads as a storm of anomalies that are really one outage."""
         self.cc = cruise_control
         self.notifier = notifier
         self.detectors = list(detectors)
         self.history_limit = history_limit
         self.initial_pass = initial_pass
         self.ready_probe = ready_probe
+        self.breaker = breaker
 
         self._queue: List[Anomaly] = []
         self._cv = threading.Condition()
@@ -127,6 +136,24 @@ class AnomalyDetectorManager:
         """One detection cycle (exposed for tests / synchronous drives)."""
         from cruise_control_tpu.obs import recorder as obs
 
+        if self.breaker is not None and self.breaker.is_open:
+            # blacked-out backend: skip the pass with a counted reason — the
+            # next cadence (or the breaker's probe closing it) retries
+            from cruise_control_tpu.core.sensors import (
+                DETECTOR_BREAKER_SKIPS_COUNTER,
+                REGISTRY,
+            )
+
+            REGISTRY.counter(DETECTOR_BREAKER_SKIPS_COUNTER).inc()
+            token = obs.start_trace("detector")
+            obs.finish_trace(
+                token,
+                attrs={
+                    "detector": type(detector).__name__,
+                    "skipped": "breaker-open",
+                },
+            )
+            return 0
         token = obs.start_trace("detector")
         try:
             anomalies = detector.run()

@@ -82,12 +82,21 @@ class CruiseControl:
         self,
         goal_ids: Optional[Sequence[int]],
         hard_ids: Optional[Sequence[int]] = None,
+        deadline_s: Optional[float] = None,
     ) -> GoalOptimizer:
         return GoalOptimizer(
             goal_ids=tuple(goal_ids) if goal_ids is not None else self.goal_ids,
             hard_ids=tuple(hard_ids) if hard_ids is not None else self.hard_ids,
             enable_heavy_goals=self.enable_heavy_goals,
-            deadline_s=self.optimize_deadline_s,
+            # per-request client budget (deadline_ms) wins over the configured
+            # default — tightening only: a request asking for less time than
+            # the server default should get less, not more
+            deadline_s=(
+                min(deadline_s, self.optimize_deadline_s)
+                if deadline_s is not None and self.optimize_deadline_s is not None
+                else (deadline_s if deadline_s is not None
+                      else self.optimize_deadline_s)
+            ),
         )
 
     def _context(
@@ -133,11 +142,14 @@ class CruiseControl:
         dryrun: bool,
         goal_ids: Optional[Sequence[int]] = None,
         hard_ids: Optional[Sequence[int]] = None,
+        deadline_s: Optional[float] = None,
         **ctx_kw,
     ) -> OperationResult:
         state, maps = model.to_arrays()
         ctx = self._context(model, maps, state, **ctx_kw)
-        final, result = self._optimizer(goal_ids, hard_ids).optimize(state, ctx, maps=maps)
+        final, result = self._optimizer(
+            goal_ids, hard_ids, deadline_s=deadline_s
+        ).optimize(state, ctx, maps=maps)
         ld_moves = logdir_moves(state, final, maps)
         execution = None
         if not dryrun and (result.proposals or ld_moves):
@@ -153,13 +165,18 @@ class CruiseControl:
         excluded_topics: Sequence[str] = (),
         triggered_by_violation: bool = False,
         requirements: ModelCompletenessRequirements = ModelCompletenessRequirements(),
+        deadline_s: Optional[float] = None,
     ) -> OperationResult:
-        """POST /rebalance (RebalanceRunnable.java:110)."""
+        """POST /rebalance (RebalanceRunnable.java:110).  ``deadline_s`` is
+        the request's remaining client budget (deadline_ms): the solve
+        returns a best-so-far ``degraded=true`` placement on expiry instead
+        of overrunning the client's patience."""
         model = self.cluster_model(requirements)
         return self._optimize_and_maybe_execute(
             model, dryrun, goal_ids,
             excluded_topics=excluded_topics,
             triggered_by_violation=triggered_by_violation,
+            deadline_s=deadline_s,
         )
 
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True, **kw) -> OperationResult:

@@ -496,8 +496,16 @@ class LoadMonitor:
     # -- state --------------------------------------------------------------
 
     def state(self) -> LoadMonitorState:
-        description = self.backend.describe_topics()
-        total = sum(len(v) for v in description.values())
+        # STATE is an observability surface: a dead/blacked-out backend (open
+        # circuit breaker, blackout chaos) must degrade it to the last-known
+        # partition total, not take it down — the operator reads this exact
+        # endpoint to diagnose the outage
+        try:
+            description = self.backend.describe_topics()
+            total = sum(len(v) for v in description.values())
+            self._last_known_total_partitions = total
+        except Exception:
+            total = getattr(self, "_last_known_total_partitions", 0)
         try:
             vae, completeness = self._partition_agg.aggregate(
                 options=AggregationOptions(include_invalid_entities=False)

@@ -282,6 +282,52 @@ def _run_controller_tier(inject_sleep_s: float = 0.0) -> dict:
     }
 
 
+def _run_serving_tier(inject_sleep_s: float = 0.0) -> dict:
+    """Serving-plane overload tier: p95 admitted latency + the shed contract.
+
+    Runs the SAME harness that commits ``benchmarks/BENCH_SERVING_cpu.json``
+    (``cruise_control_tpu/api/bench.py``): hundreds of concurrent REST
+    clients against the fake backend with tight admission knobs.  The
+    contract violations — any HTTP 5xx, any shed (429) response missing
+    Retry-After, a workload that failed to overload or failed to serve — are
+    hard errors; the p95 admitted latency is the gated wall (>25 % vs the
+    committed artifact fails, see ``_serving_baseline``)."""
+    _force_cpu_platform()
+    from cruise_control_tpu.api import bench
+
+    m = bench.run_bench()
+    contract = bench.check_contract(m)
+    if contract:
+        return {"tier": "serving", "error": "; ".join(contract)}
+    wall = m["p95_admitted_s"]
+    if inject_sleep_s:
+        time.sleep(inject_sleep_s)
+        wall += inject_sleep_s
+    return {
+        "tier": "serving",
+        "platform": "cpu",
+        "wall_s": round(wall, 4),
+        "admitted": m["admitted"],
+        "shed": m["shed"],
+        "http_5xx": m["http_5xx"],
+        "sheds_missing_retry_after": m["sheds_missing_retry_after"],
+        "goodput_rps": m["goodput_rps"],
+    }
+
+
+def _serving_baseline(root: str) -> Optional[dict]:
+    """Gate baseline for the serving tier, derived from the committed bench
+    artifact (``benchmarks/BENCH_SERVING_cpu.json``) — same single-source
+    pattern as the controller tier."""
+    path = os.path.join(root, "benchmarks", "BENCH_SERVING_cpu.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return {"wall_s": doc.get("p95_admitted_s")}
+
+
 def _controller_baseline(root: str) -> Optional[dict]:
     """Gate baseline for the controller tier, derived from the committed
     bench artifact (``benchmarks/BENCH_CONTROLLER_cpu.json``) — the ISSUE
@@ -315,9 +361,15 @@ TIERS: Dict[str, GateTier] = {
                  "contract vs BENCH_CONTROLLER_cpu.json",
                  build=None, bench_comparable=False,
                  runner=_run_controller_tier),
+        GateTier("serving", "overload plane: p95 admitted latency + shed "
+                 "contract vs BENCH_SERVING_cpu.json",
+                 build=None, bench_comparable=False,
+                 runner=_run_serving_tier),
     )
 }
-DEFAULT_TIERS = ("config1", "config2_small", "mesh8", "exporter", "controller")
+DEFAULT_TIERS = (
+    "config1", "config2_small", "mesh8", "exporter", "controller", "serving",
+)
 
 
 # -- measurement --------------------------------------------------------------------
@@ -661,6 +713,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         elif "series" in m:   # exporter tier gates render wall only
             status = f"wall={m['wall_s']}s series={m.get('series')}"
+        elif "goodput_rps" in m:   # serving tier: admitted p95 + shed contract
+            status = (
+                f"p95_admitted={m['wall_s']}s admitted={m.get('admitted')} "
+                f"shed={m.get('shed')} 5xx={m.get('http_5xx')} "
+                f"goodput={m.get('goodput_rps')}rps"
+            )
         else:   # controller tier: reaction p50 + the zero-compile contract
             status = (
                 f"reaction_p50={m['wall_s']}s "
@@ -710,6 +768,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             # (benchmarks/BENCH_CONTROLLER_cpu.json), not GATE_BASELINE —
             # one number, one file, regenerated by scripts/bench_controller.py
             base = _controller_baseline(root)
+        if base is None and m["tier"] == "serving":
+            # same single-source pattern: the serving tier gates against
+            # benchmarks/BENCH_SERVING_cpu.json (scripts/bench_serving.py)
+            base = _serving_baseline(root)
         if base is None:
             failures.append(
                 f"{m['tier']}: no committed gate baseline for this tier "

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Serving-plane overload bench: admitted-p95 + the shed contract, gated.
+
+Thin CLI over ``cruise_control_tpu/api/bench.py`` (the same harness the
+``serving`` tier in ``obs/gate.py`` runs): boots the whole app on the fake
+backend with tight admission knobs, slams it with hundreds of concurrent REST
+clients, and enforces two kinds of verdicts against the committed
+``benchmarks/BENCH_SERVING_cpu.json``:
+
+* **hard contract** (threshold-free, exit 1): any HTTP 5xx anywhere, any shed
+  (429) response missing its Retry-After header, or a workload that failed to
+  overload (nothing shed) / failed to serve (nothing admitted).
+* **regression** (exit 1): p95 admitted latency above baseline × 1.25 (after
+  an absolute noise floor, × ``CC_TPU_GATE_WALL_SLACK`` on shared runners).
+
+A workload mismatch vs the baseline is an infrastructure error (exit 2).
+
+    python scripts/bench_serving.py                     # run + gate
+    python scripts/bench_serving.py --update-baseline   # regenerate baseline
+    python scripts/bench_serving.py --clients 50        # quick smoke (no gate)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from cruise_control_tpu.api import bench  # noqa: E402
+
+BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "BENCH_SERVING_cpu.json",
+)
+MAX_WALL_RATIO = 1.25
+WALL_FLOOR_S = 0.25
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--clients", type=int, default=bench.CLIENTS,
+                    help="concurrent REST clients (non-default skips the "
+                         "baseline compare — the workload differs)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    doc = bench.run_bench(clients=args.clients)
+    print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+
+    # the hard contract binds at every scale, baseline or not
+    contract = bench.check_contract(doc)
+    if contract:
+        print("SERVING CONTRACT VIOLATED:", file=sys.stderr)
+        for c in contract:
+            print(f"  - {c}", file=sys.stderr)
+        return 1
+
+    if args.update_baseline:
+        with open(BASELINE, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"baseline written: {BASELINE}", file=sys.stderr)
+        return 0
+
+    if args.clients != bench.CLIENTS:
+        print("non-default workload: contract checked, baseline compare "
+              "skipped", file=sys.stderr)
+        return 0
+
+    if not os.path.exists(BASELINE):
+        print(f"missing baseline {BASELINE}; run --update-baseline",
+              file=sys.stderr)
+        return 2
+    with open(BASELINE) as f:
+        base = json.load(f)
+    if base.get("workload") != doc["workload"]:
+        print("workload mismatch vs baseline — regenerate with "
+              "--update-baseline", file=sys.stderr)
+        return 2
+    slack = float(os.environ.get("CC_TPU_GATE_WALL_SLACK", "1.0"))
+    budget = base["p95_admitted_s"] * MAX_WALL_RATIO * slack + WALL_FLOOR_S
+    if doc["p95_admitted_s"] > budget:
+        print(
+            f"SERVING REGRESSION: p95 admitted {doc['p95_admitted_s']:.3f}s "
+            f"> budget {budget:.3f}s (baseline {base['p95_admitted_s']:.3f}s "
+            f"× {MAX_WALL_RATIO} × slack {slack} + {WALL_FLOOR_S}s floor)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"serving gate OK: p95 admitted {doc['p95_admitted_s']:.3f}s <= "
+        f"budget {budget:.3f}s; {doc['admitted']} admitted / {doc['shed']} "
+        "shed, 0 × 5xx, all sheds carried Retry-After",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

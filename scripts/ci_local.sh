@@ -39,6 +39,12 @@ python -m pytest tests/test_controller.py -x -q
 echo "== controller bench (reaction-latency p50 + warm-tick 0-compile vs committed baseline) =="
 python scripts/bench_controller.py >/dev/null
 
+echo "== admission tier (overload plane: admission, quotas, breaker, blackout drill) =="
+python -m pytest tests/test_admission.py -x -q
+
+echo "== serving bench (200 concurrent clients: shed contract + admitted-p95 vs committed baseline) =="
+python scripts/bench_serving.py >/dev/null
+
 echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check) =="
 python scripts/bench_gate.py
 

@@ -1,0 +1,960 @@
+"""Overload-resilient serving plane tier (ISSUE 13).
+
+Covers the three coupled layers end to end:
+
+* admission control — token buckets, per-principal quotas, the bounded
+  priority queue, and the shed contract (429 + Retry-After, never a 500),
+  including the concurrent-hammering invariants (caps never exceeded, the
+  dedupe path consumes no quota);
+* the backend circuit breaker — closed → open → half-open state machine,
+  fail-fast composition with the retry policy, and the kill-the-backend
+  drill: liveness/observability keep answering, detectors and the controller
+  skip with counted reasons, REBALANCE degrades to the journaled standing
+  proposal set marked ``degraded=true``;
+* derived Retry-After — task-cap overflow maps to 429 over real HTTP
+  (regression: it used to escape as a bare 500), and readiness 503s carry a
+  progress-derived Retry-After on both the recovering and warming rungs.
+
+Plus the warm-path budget acceptance: admission adds 0 JAX dispatches and 0
+compile events to the optimize path, asserted from the obs flight record.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu.api.admission import (
+    ANONYMOUS_PRINCIPAL,
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionRefused,
+    TokenBucket,
+)
+from cruise_control_tpu.api.security import Role
+from cruise_control_tpu.api.server import ReadinessController, ReadinessState
+from cruise_control_tpu.api.usertasks import TaskStatus, UserTaskManager
+from cruise_control_tpu.backend import FakeClusterBackend
+from cruise_control_tpu.backend.breaker import (
+    BreakerBackend,
+    BreakerOpenError,
+    CircuitBreaker,
+)
+from cruise_control_tpu.core.sensors import (
+    ADMISSION_ADMITTED_COUNTER,
+    ADMISSION_DEDUPE_HITS_COUNTER,
+    ADMISSION_SHED_COUNTER,
+    ADMISSION_SHED_DEADLINE_COUNTER,
+    ADMISSION_SHED_QUEUE_FULL_COUNTER,
+    BREAKER_OPENS_COUNTER,
+    CONTROLLER_BREAKER_SKIPS_COUNTER,
+    DETECTOR_BREAKER_SKIPS_COUNTER,
+    REGISTRY,
+)
+
+WINDOW_MS = 60_000
+TRIMMED_GOALS = "RackAwareGoal,ReplicaCapacityGoal,ReplicaDistributionGoal"
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def counter(name: str) -> int:
+    return REGISTRY.counter(name).value
+
+
+def seeded_backend(num_brokers=4, partitions=12):
+    backend = FakeClusterBackend()
+    for b in range(num_brokers):
+        backend.add_broker(b, rack=str(b % 2))
+    for p in range(partitions):
+        backend.create_partition(
+            ("T", p), [p % 2, (p % 2 + 1) % num_brokers], load=[1.5, 4e3, 6e3, 3e4]
+        )
+    return backend
+
+
+def base_props(**overrides):
+    props = {
+        "partition.metrics.window.ms": WINDOW_MS,
+        "num.partition.metrics.windows": 4,
+        "metric.sampling.interval.ms": 3_600_000,
+        "anomaly.detection.interval.ms": 3_600_000,
+        "anomaly.detection.initial.pass": False,
+        "broker.capacity.config.resolver.class":
+            "cruise_control_tpu.monitor.capacity.StaticCapacityResolver",
+        "sample.store.class":
+            "cruise_control_tpu.monitor.samplestore.NoopSampleStore",
+        "webserver.http.port": 0,
+        "min.valid.partition.ratio": 0.5,
+        "default.goals": TRIMMED_GOALS,
+    }
+    props.update(overrides)
+    return props
+
+
+def make_app(backend=None, **overrides):
+    from cruise_control_tpu.app import CruiseControlTpuApp
+    from cruise_control_tpu.core.resources import Resource
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+
+    app = CruiseControlTpuApp(
+        base_props(**overrides), backend=backend or seeded_backend()
+    )
+    app.monitor.capacity_resolver = StaticCapacityResolver(
+        {Resource.CPU: 100.0, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6,
+         Resource.DISK: 1e7}
+    )
+    return app
+
+
+def sample_windows(app, n=6):
+    now = int(time.time() * 1000)
+    for w in range(n):
+        app.monitor.sample_once(now_ms=now + w * WINDOW_MS)
+
+
+def poll_until(fn, timeout_s=30.0, interval_s=0.02, desc="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+# -- token bucket -------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        clk = FakeClock()
+        b = TokenBucket(qps=2.0, burst=2.0, clock=clk)
+        assert b.try_acquire() == (True, 0.0)
+        assert b.try_acquire() == (True, 0.0)
+        ok, wait = b.try_acquire()
+        assert not ok and wait == pytest.approx(0.5)
+        clk.t += 0.5
+        assert b.try_acquire()[0]
+        # refill never exceeds the burst cap
+        clk.t += 100.0
+        assert b.try_acquire()[0] and b.try_acquire()[0]
+        assert not b.try_acquire()[0]
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        clk = FakeClock()
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("open_s", 10.0)
+        kw.setdefault("backoff_multiplier", 2.0)
+        kw.setdefault("max_open_s", 60.0)
+        kw.setdefault("jitter", 0.0)
+        return CircuitBreaker(clock=clk, **kw), clk
+
+    def test_opens_after_consecutive_failures_only(self):
+        br, _ = self.make()
+        err = ConnectionError("down")
+        br.record_failure(err)
+        br.record_failure(err)
+        br.record_success()          # success resets the streak
+        br.record_failure(err)
+        br.record_failure(err)
+        assert not br.is_open
+        br.record_failure(err)
+        assert br.is_open and br.opens == 1
+
+    def test_fail_fast_then_single_probe_then_close(self):
+        br, clk = self.make()
+        err = ConnectionError("down")
+        for _ in range(3):
+            br.record_failure(err)
+        with pytest.raises(BreakerOpenError) as exc:
+            br.before_call("describe_cluster")
+        assert exc.value.retry_after_s == pytest.approx(10.0, abs=0.1)
+        assert br.fast_failures == 1
+        # cooldown expires: exactly ONE caller becomes the probe
+        clk.t += 10.01
+        assert br.before_call("describe_cluster") is True
+        with pytest.raises(BreakerOpenError):
+            br.before_call("describe_cluster")
+        br.record_success(probe=True)
+        assert not br.is_open and br.closes == 1
+        assert br.before_call("describe_cluster") is False   # closed: no probe
+
+    def test_failed_probe_reopens_with_longer_cooldown(self):
+        br, clk = self.make()
+        err = ConnectionError("down")
+        for _ in range(3):
+            br.record_failure(err)
+        clk.t += 10.01
+        assert br.before_call("x") is True
+        br.record_failure(err, probe=True)
+        assert br.is_open and br.opens == 2
+        # exponential probe backoff: second open cooldown = 10 × 2
+        assert br.retry_after_s() == pytest.approx(20.0, abs=0.1)
+
+    def test_hung_probe_is_reclaimed_after_a_cooldown(self):
+        """Review fix: a probe that never reports (hung socket, killed
+        thread) must not wedge the seam half-open forever — after a full
+        cooldown the probe token is reclaimed by the next caller."""
+        br, clk = self.make()
+        err = ConnectionError("down")
+        for _ in range(3):
+            br.record_failure(err)
+        clk.t += 10.01
+        assert br.before_call("x") is True      # the probe... which hangs
+        with pytest.raises(BreakerOpenError):
+            br.before_call("x")                 # still guarded meanwhile
+        clk.t += 10.01                          # one whole cooldown later
+        assert br.before_call("x") is True      # reclaimed
+        br.record_success(probe=True)
+        assert not br.is_open
+
+    def test_breaker_backend_guards_and_delegates(self):
+        class Flaky:
+            def __init__(self):
+                self.calls = 0
+                self.fail = True
+
+            def describe_cluster(self):
+                self.calls += 1
+                if self.fail:
+                    raise ConnectionError("down")
+                return "ok"
+
+            def kill_broker(self, b):       # test-helper surface
+                return f"killed {b}"
+
+        br, clk = self.make(failure_threshold=2)
+        inner = Flaky()
+        bb = BreakerBackend(inner, br)
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                bb.describe_cluster()
+        assert br.is_open
+        with pytest.raises(BreakerOpenError):
+            bb.describe_cluster()
+        assert inner.calls == 2              # fail-fast never touched the backend
+        assert bb.kill_broker(1) == "killed 1"   # unknown attrs delegate
+        clk.t += 10.01
+        inner.fail = False
+        assert bb.describe_cluster() == "ok"     # the probe closes it
+        assert not br.is_open
+
+    def test_retry_policy_treats_open_breaker_as_fatal(self):
+        from cruise_control_tpu.core.retry import RetryPolicy
+
+        policy = RetryPolicy(max_attempts=5, base_backoff_s=0.0,
+                             sleep=lambda s: None)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise BreakerOpenError("backend.describe_cluster", 5.0)
+
+        with pytest.raises(BreakerOpenError):
+            policy.call(fn, op_name="backend.describe_cluster")
+        assert len(calls) == 1   # no retries: the whole point of the breaker
+
+
+# -- admission controller -----------------------------------------------------
+
+
+class TestAdmissionController:
+    def test_immediate_admit_and_release_accounting(self):
+        ctrl = AdmissionController(AdmissionConfig(max_concurrent=2))
+        t1 = ctrl.acquire("alice", "REBALANCE", role=Role.USER, anonymous=False)
+        t2 = ctrl.acquire("bob", "REBALANCE", role=Role.USER, anonymous=False)
+        snap = ctrl.snapshot()
+        assert snap["active"] == 2
+        assert snap["activeByPrincipal"] == {"alice": 1, "bob": 1}
+        t1.release()
+        t1.release()                       # idempotent
+        t2.release()
+        snap = ctrl.snapshot()
+        assert snap["active"] == 0 and snap["activeByPrincipal"] == {}
+
+    def test_disabled_admission_returns_none(self):
+        ctrl = AdmissionController(AdmissionConfig(enabled=False))
+        assert ctrl.acquire("x", "REBALANCE") is None
+        ctrl.check_rate("x", "LOAD")       # no-op
+
+    def test_principal_quota_shed_is_instant(self):
+        ctrl = AdmissionController(
+            AdmissionConfig(max_concurrent=10, max_tasks_per_principal=1)
+        )
+        t1 = ctrl.acquire("alice", "REBALANCE")
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRefused) as exc:
+            ctrl.acquire("alice", "REBALANCE")
+        assert time.monotonic() - t0 < 0.5       # no queue wait for quota sheds
+        assert exc.value.reason == "principal-quota"
+        assert exc.value.retry_after_s >= 1.0
+        # another principal is unaffected
+        t2 = ctrl.acquire("bob", "REBALANCE")
+        t1.release()
+        t2.release()
+
+    def test_queue_full_and_deadline_sheds(self):
+        ctrl = AdmissionController(
+            AdmissionConfig(max_concurrent=1, queue_capacity=1,
+                            queue_timeout_s=0.15)
+        )
+        held = ctrl.acquire("a", "REBALANCE")
+        try:
+            results = {}
+
+            def waiter():
+                try:
+                    t = ctrl.acquire("b", "REBALANCE")
+                    t.release()
+                    results["b"] = "admitted"
+                except AdmissionRefused as e:
+                    results["b"] = e.reason
+
+            th = threading.Thread(target=waiter)
+            th.start()
+            poll_until(lambda: ctrl.snapshot()["queueDepth"] == 1,
+                       desc="waiter queued")
+            # queue full: the next arrival sheds instantly
+            with pytest.raises(AdmissionRefused) as exc:
+                ctrl.acquire("c", "REBALANCE")
+            assert exc.value.reason == "queue-full"
+            th.join(timeout=5)
+            # the queued waiter shed on the queue timeout, before any solver
+            assert results["b"] == "deadline"
+        finally:
+            held.release()
+
+    def test_client_deadline_bounds_queue_wait(self):
+        ctrl = AdmissionController(
+            AdmissionConfig(max_concurrent=1, queue_capacity=4,
+                            queue_timeout_s=30.0)
+        )
+        held = ctrl.acquire("a", "REBALANCE")
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(AdmissionRefused) as exc:
+                ctrl.acquire("b", "REBALANCE", deadline_s=0.1)
+            assert exc.value.reason == "deadline"
+            assert time.monotonic() - t0 < 5.0   # the 30s queue policy lost
+        finally:
+            held.release()
+
+    def test_priority_mutation_outranks_analytics(self):
+        ctrl = AdmissionController(
+            AdmissionConfig(max_concurrent=1, queue_capacity=8,
+                            queue_timeout_s=10.0)
+        )
+        held = ctrl.acquire("op", "REBALANCE", role=Role.ADMIN, anonymous=False)
+        order = []
+
+        def waiter(name, endpoint, role):
+            t = ctrl.acquire(name, endpoint, role=role, anonymous=False)
+            order.append(name)
+            t.release()
+
+        # the analytics sweep queues FIRST, the mutation second — priority
+        # (endpoint class × tier) must drain the mutation first anyway
+        a = threading.Thread(
+            target=waiter, args=("viewer-sim", "SIMULATE", Role.VIEWER)
+        )
+        a.start()
+        poll_until(lambda: ctrl.snapshot()["queueDepth"] == 1, desc="first queued")
+        b = threading.Thread(
+            target=waiter, args=("admin-reb", "REBALANCE", Role.ADMIN)
+        )
+        b.start()
+        poll_until(lambda: ctrl.snapshot()["queueDepth"] == 2, desc="second queued")
+        held.release()
+        a.join(timeout=10)
+        b.join(timeout=10)
+        assert order == ["admin-reb", "viewer-sim"]
+
+    def test_shed_deadline_helper_is_accounted(self):
+        """Review fix: the mid-work budget-exhausted refusal must go through
+        the same accounting as every other shed (counters + reason split)."""
+        ctrl = AdmissionController(AdmissionConfig())
+        shed0 = counter(ADMISSION_SHED_COUNTER)
+        deadline0 = counter(ADMISSION_SHED_DEADLINE_COUNTER)
+        with pytest.raises(AdmissionRefused) as exc:
+            ctrl.shed_deadline("alice", "REBALANCE", "budget spent")
+        assert exc.value.reason == "deadline"
+        assert counter(ADMISSION_SHED_COUNTER) - shed0 == 1
+        assert counter(ADMISSION_SHED_DEADLINE_COUNTER) - deadline0 == 1
+        assert ctrl.shed_by_reason == {"deadline": 1}
+
+    def test_peek_expires_first(self):
+        """Review fix: a key whose retained task aged out must peek as a
+        MISS — otherwise the caller skips admission while get_or_create
+        creates a brand-new UNTICKETED task (a solve outside every quota)."""
+        manager = UserTaskManager(max_workers=1, completed_retention_ms=50)
+        task = manager.get_or_create("REBALANCE", ("k",), lambda p: 1)
+        task.future.result(timeout=5)
+        assert manager.peek(("k",)) is task
+        time.sleep(0.08)
+        assert manager.peek(("k",)) is None     # expired == admission runs
+        manager.shutdown()
+
+    def test_rate_limit_sheds_with_time_to_next_token(self):
+        clk = FakeClock()
+        ctrl = AdmissionController(
+            AdmissionConfig(rate_qps=2.0, rate_burst=2.0), clock=clk
+        )
+        ctrl.check_rate("alice", "LOAD")
+        ctrl.check_rate("alice", "LOAD")
+        with pytest.raises(AdmissionRefused) as exc:
+            ctrl.check_rate("alice", "LOAD")
+        assert exc.value.reason == "rate-limited"
+        assert exc.value.retry_after_s >= 1.0
+        ctrl.check_rate("bob", "LOAD")       # per-principal buckets
+        clk.t += 1.0
+        ctrl.check_rate("alice", "LOAD")     # refilled
+
+
+# -- concurrent admission (satellite: caps never exceeded) --------------------
+
+
+class TestConcurrentAdmission:
+    def test_hammering_never_exceeds_caps(self):
+        """36 threads × 3 principals through acquire → get_or_create: the
+        global cap and every per-principal quota hold at every instant
+        (peaks measured inside the work itself), and admitted + shed
+        accounts for every attempt — from sensors AND the task table."""
+        cfg = AdmissionConfig(
+            max_concurrent=4, max_tasks_per_principal=2,
+            queue_capacity=100, queue_timeout_s=10.0,
+        )
+        ctrl = AdmissionController(cfg)
+        manager = UserTaskManager(max_workers=8, max_active_tasks=4)
+        admitted0 = counter(ADMISSION_ADMITTED_COUNTER)
+        shed0 = counter(ADMISSION_SHED_COUNTER)
+
+        lock = threading.Lock()
+        running = {"__all__": 0}
+        peaks = {"__all__": 0}
+
+        def make_work(principal):
+            def work(progress):
+                with lock:
+                    running[principal] = running.get(principal, 0) + 1
+                    running["__all__"] += 1
+                    peaks[principal] = max(
+                        peaks.get(principal, 0), running[principal]
+                    )
+                    peaks["__all__"] = max(peaks["__all__"], running["__all__"])
+                time.sleep(0.02)
+                with lock:
+                    running[principal] -= 1
+                    running["__all__"] -= 1
+                return {"ok": True}
+            return work
+
+        results = {"admitted": 0, "shed": 0}
+
+        def client(i):
+            principal = f"p{i % 3}"
+            try:
+                ticket = ctrl.acquire(
+                    principal, "REBALANCE", role=Role.USER, anonymous=False
+                )
+            except AdmissionRefused:
+                with lock:
+                    results["shed"] += 1
+                return
+            task = manager.get_or_create(
+                "REBALANCE", ("k", i), make_work(principal),
+                admission_ticket=ticket,
+            )
+            task.future.result(timeout=30)
+            with lock:
+                results["admitted"] += 1
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(36)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+
+        assert peaks["__all__"] <= 4, f"global cap exceeded: {peaks}"
+        for p in ("p0", "p1", "p2"):
+            assert peaks.get(p, 0) <= 2, f"quota exceeded for {p}: {peaks}"
+        assert results["admitted"] + results["shed"] == 36
+        assert results["admitted"] >= 4      # the queue did drain work
+        # sensors account exactly
+        assert counter(ADMISSION_ADMITTED_COUNTER) - admitted0 == results["admitted"]
+        assert counter(ADMISSION_SHED_COUNTER) - shed0 == results["shed"]
+        # final task table: nothing active, nothing leaked a slot
+        assert not [
+            t for t in manager.all_tasks()
+            if t.status in (TaskStatus.ACTIVE, TaskStatus.IN_EXECUTION)
+        ]
+        assert ctrl.snapshot()["active"] == 0
+        manager.shutdown()
+
+    def test_dedupe_hit_consumes_no_quota(self):
+        """The dedupe path must not consume quota: a racing duplicate whose
+        ticket loses the creation race gets it released by get_or_create,
+        and resubmissions of a registered key never acquire at all."""
+        ctrl = AdmissionController(
+            AdmissionConfig(max_concurrent=8, max_tasks_per_principal=2)
+        )
+        manager = UserTaskManager(max_workers=2, max_active_tasks=8)
+        done = threading.Event()
+
+        def slow_work(progress):
+            done.wait(10)
+            return {"ok": True}
+
+        # two racers, both past the peek (no task yet), both holding tickets
+        t_a = ctrl.acquire("alice", "REBALANCE")
+        t_b = ctrl.acquire("alice", "REBALANCE")
+        assert ctrl.snapshot()["activeByPrincipal"] == {"alice": 2}
+        task1 = manager.get_or_create("REBALANCE", ("dup",), slow_work,
+                                      admission_ticket=t_a)
+        task2 = manager.get_or_create("REBALANCE", ("dup",), slow_work,
+                                      admission_ticket=t_b)
+        assert task2 is task1
+        # the loser's ticket was released inside get_or_create: only ONE
+        # slot is held for the one real operation
+        assert ctrl.snapshot()["activeByPrincipal"] == {"alice": 1}
+        # resubmission of a registered key: the server's peek path — no
+        # acquire, just the dedupe counter
+        dedupe0 = counter(ADMISSION_DEDUPE_HITS_COUNTER)
+        assert manager.peek(("dup",)) is task1
+        ctrl.note_dedupe_hit()
+        assert counter(ADMISSION_DEDUPE_HITS_COUNTER) - dedupe0 == 1
+        # alice's quota has exactly one slot in use: a second distinct
+        # operation still fits (quota=2)
+        t_c = ctrl.acquire("alice", "REBALANCE")
+        t_c.release()
+        done.set()
+        task1.future.result(timeout=10)
+        poll_until(lambda: ctrl.snapshot()["active"] == 0, desc="slot released")
+        manager.shutdown()
+
+
+# -- derived Retry-After (readiness rungs) ------------------------------------
+
+
+class TestReadinessRetryAfter:
+    def test_recovering_rung_scales_with_elapsed(self):
+        rc = ReadinessController(retry_after_default_s=5, warming_hint_s=120.0)
+        rc.set_phase(ReadinessState.RECOVERING)
+        # just entered: the floor (default) — zero-progress estimate
+        assert rc.retry_after_s() == 5
+        # 12 s deep: the doubling estimate suggests ~12 more
+        rc.history[-1] = (ReadinessState.RECOVERING, time.time() - 12.0)
+        assert 12 <= rc.retry_after_s() <= 13
+        # pathological recovery: capped at 60
+        rc.history[-1] = (ReadinessState.RECOVERING, time.time() - 600.0)
+        assert rc.retry_after_s() == 60
+
+    def test_warming_rung_uses_sampling_hint(self):
+        rc = ReadinessController(retry_after_default_s=5, warming_hint_s=120.0)
+        rc.set_phase(ReadinessState.MONITOR_WARMING)
+        assert rc.retry_after_s() == 120
+        # capped at 300 (an hourly sampler must not tell probes "3600")
+        rc2 = ReadinessController(retry_after_default_s=5, warming_hint_s=3600.0)
+        rc2.set_phase(ReadinessState.MONITOR_WARMING)
+        assert rc2.retry_after_s() == 300
+
+    def test_fallback_default_without_hint(self):
+        rc = ReadinessController(retry_after_default_s=7)
+        rc.set_phase(ReadinessState.MONITOR_WARMING)
+        assert rc.retry_after_s() == 7
+
+
+# -- over real HTTP: overflow 429, readiness Retry-After, shed contract -------
+
+
+@pytest.fixture(scope="module")
+def served_app():
+    """Module app: admission enabled, 2 execution slots, a 2-deep queue."""
+    app = make_app(
+        **{
+            "max.active.user.tasks": 2,
+            "admission.queue.capacity": 2,
+            "admission.queue.timeout.ms": 2_000,
+        }
+    )
+    sample_windows(app)
+    app.start(serve_http=True)
+    yield app
+    app.stop()
+
+
+@pytest.fixture(scope="module")
+def client(served_app):
+    from cruise_control_tpu.client import CruiseControlClient
+
+    return CruiseControlClient(
+        f"http://127.0.0.1:{served_app.port}", poll_timeout_s=600.0
+    )
+
+
+class TestShedOverHTTP:
+    def test_queue_full_and_deadline_shed_with_retry_after(self, served_app, client):
+        from cruise_control_tpu.client import ClientError
+
+        app = served_app.app
+        queue_full0 = counter(ADMISSION_SHED_QUEUE_FULL_COUNTER)
+        deadline0 = counter(ADMISSION_SHED_DEADLINE_COUNTER)
+        # occupy both execution slots
+        held = [
+            app.admission.acquire(ANONYMOUS_PRINCIPAL, "REBALANCE")
+            for _ in range(2)
+        ]
+        results = {}
+
+        def queued_post(tag):
+            from cruise_control_tpu.client import CruiseControlClient
+
+            c = CruiseControlClient(f"http://127.0.0.1:{served_app.port}")
+            try:
+                c.rebalance(dryrun=True, excluded_topics=f"none-{tag}",
+                            wait=False)
+                results[tag] = ("ok", None)
+            except ClientError as e:
+                results[tag] = (e.status, e.retry_after_s)
+
+        try:
+            threads = [
+                threading.Thread(target=queued_post, args=(i,)) for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            poll_until(
+                lambda: app.admission.snapshot()["queueDepth"] == 2,
+                desc="two requests queued",
+            )
+            # the queue is full: the next arrival sheds INSTANTLY with 429
+            t0 = time.monotonic()
+            with pytest.raises(ClientError) as exc:
+                client.rebalance(dryrun=True, excluded_topics="none-x",
+                                 wait=False)
+            assert time.monotonic() - t0 < 1.5
+            assert exc.value.status == 429
+            assert exc.value.retry_after_s and exc.value.retry_after_s >= 1
+            assert exc.value.body["reason"] == "queue-full"
+            # the two queued requests shed on the queue timeout — 429 +
+            # Retry-After, not a deadlock and not a 500
+            for t in threads:
+                t.join(timeout=30)
+            for status, retry_after in results.values():
+                assert status == 429
+                assert retry_after and retry_after >= 1
+        finally:
+            for t in held:
+                t.release()
+        assert counter(ADMISSION_SHED_QUEUE_FULL_COUNTER) - queue_full0 == 1
+        assert counter(ADMISSION_SHED_DEADLINE_COUNTER) - deadline0 == 2
+        # recovery: with the slots free the same request is admitted
+        out = client.rebalance(dryrun=True, excluded_topics="none-x2")
+        assert "proposals" in out
+
+    def test_client_deadline_ms_sheds_before_solver(self, served_app):
+        from cruise_control_tpu.client import ClientError, CruiseControlClient
+
+        app = served_app.app
+        held = [
+            app.admission.acquire(ANONYMOUS_PRINCIPAL, "REBALANCE")
+            for _ in range(2)
+        ]
+        try:
+            c = CruiseControlClient(f"http://127.0.0.1:{served_app.port}")
+            t0 = time.monotonic()
+            with pytest.raises(ClientError) as exc:
+                c.rebalance(dryrun=True, excluded_topics="budget",
+                            deadline_ms=200, wait=False)
+            # shed at the 200 ms client budget, NOT the 2 s queue policy
+            assert time.monotonic() - t0 < 1.5
+            assert exc.value.status == 429
+            assert exc.value.body["reason"] == "deadline"
+        finally:
+            for t in held:
+                t.release()
+
+    def test_dedupe_over_http_consumes_no_slot(self, served_app, client):
+        app = served_app.app
+        admitted0 = counter(ADMISSION_ADMITTED_COUNTER)
+        dedupe0 = counter(ADMISSION_DEDUPE_HITS_COUNTER)
+        out1 = client.rebalance(dryrun=True, excluded_topics="dedupe-tag")
+        out2 = client.rebalance(dryrun=True, excluded_topics="dedupe-tag")
+        assert out1["numProposals"] == out2["numProposals"]
+        assert counter(ADMISSION_ADMITTED_COUNTER) - admitted0 == 1
+        assert counter(ADMISSION_DEDUPE_HITS_COUNTER) - dedupe0 >= 1
+        assert app.admission.snapshot()["active"] == 0
+
+    def test_state_serves_admission_block(self, client):
+        from cruise_control_tpu.api.schemas import validate_endpoint
+
+        body = client.state()
+        assert body["Admission"]["enabled"] is True
+        assert body["Breaker"]["state"] == "closed"
+        validate_endpoint("STATE", body)
+
+    def test_rate_limit_429_over_handle(self, served_app):
+        """Token-bucket shedding through the full dispatch path (the module
+        app keeps qps unlimited; flip on a near-zero refill temporarily so
+        the burst is the whole budget)."""
+        app = served_app.app
+        app.admission.cfg.rate_qps = 0.001
+        app.admission.cfg.rate_burst = 2.0
+        try:
+            statuses, headers_seen = [], []
+            for _ in range(4):
+                status, body, headers = app.handle("GET", "LOAD", {}, {})
+                statuses.append(status)
+                headers_seen.append(headers)
+            assert statuses[:2] == [200, 200]
+            assert statuses[2:] == [429, 429]
+            for h in headers_seen[2:]:
+                assert int(h["Retry-After"]) >= 1
+            # cheap reads bypass the dry bucket: observability stays alive
+            status, _, _ = app.handle("GET", "STATE", {}, {})
+            assert status == 200
+        finally:
+            app.admission.cfg.rate_qps = 0.0
+            app.admission._buckets.clear()
+
+    def test_malformed_deadline_ms_is_a_400_not_a_reset(self, served_app):
+        """Review fix: int('abc') used to escape handle() and abort the
+        socket — a malformed budget must be an HTTP 400 answer."""
+        app = served_app.app
+        for bad in ("abc", "1.5", "-100", "0"):
+            status, body, _ = app.handle(
+                "POST", "REBALANCE", {"deadline_ms": [bad]}, {}
+            )
+            assert status == 400, (bad, status, body)
+            assert "deadline_ms" in body["error"]
+        # and over real HTTP the connection carries the 400
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{served_app.port}/kafkacruisecontrol/"
+            "rebalance?deadline_ms=abc",
+            method="POST", data=b"",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 400
+
+    def test_warm_optimize_budget_unchanged_with_admission(self, served_app, client):
+        """Acceptance: admission adds 0 JAX dispatches and 0 compile events
+        to the optimize path — asserted from the obs flight record."""
+        client.rebalance(dryrun=True, excluded_topics="budget-a")   # warm
+        client.rebalance(dryrun=True, excluded_topics="budget-b")   # measured
+        traces = client.traces(kind="optimize", limit=2)["traces"]
+        assert len(traces) == 2
+        warm, prev = traces[0], traces[1]
+        assert warm["compile_events"] == []
+        warm_disp = sum(s["dispatches"] for s in warm["spans"])
+        prev_disp = sum(s["dispatches"] for s in prev["spans"])
+        assert warm_disp == prev_disp
+        # and admission was actually live for these requests
+        assert counter(ADMISSION_ADMITTED_COUNTER) > 0
+
+
+class TestOverflowAndReadinessHTTP:
+    def test_task_cap_429_and_warming_retry_after(self, tmp_path):
+        """Satellite regressions over real HTTP: (1) the readiness 503's
+        Retry-After is derived (sampling-interval hint on the warming rung),
+        not the old hardcoded \"5\"; (2) the task-cap overflow that used to
+        escape as RuntimeError → 500 now answers 429 + Retry-After."""
+        from cruise_control_tpu.client import ClientError, CruiseControlClient
+
+        app = make_app(
+            **{
+                "max.active.user.tasks": 1,
+                "admission.enable": False,   # expose the raw backstop
+                "metric.sampling.interval.ms": 120_000,
+                "retry.after.default.s": 3,
+            }
+        )
+        app.start(serve_http=True)    # NO samples: parked at monitor_warming
+        try:
+            c = CruiseControlClient(f"http://127.0.0.1:{app.port}")
+            # warming rung: Retry-After == the sampling interval (120 s)
+            with pytest.raises(ClientError) as exc:
+                c.proposals()
+            assert exc.value.status == 503
+            assert exc.value.retry_after_s == 120
+            with pytest.raises(ClientError) as exc:
+                c.healthz(readiness=True)
+            assert exc.value.status == 503
+            assert exc.value.retry_after_s == 120
+            # warm it up → ready
+            sample_windows(app)
+            assert c.healthz(readiness=True)["ready"]
+            # occupy the single task slot with a slow task, directly
+            gate = threading.Event()
+            app.app.user_tasks.get_or_create(
+                "REBALANCE", ("blocker",), lambda p: gate.wait(30)
+            )
+            try:
+                with pytest.raises(ClientError) as exc:
+                    c.rebalance(dryrun=True, excluded_topics="overflow",
+                                wait=False)
+                assert exc.value.status == 429, (
+                    "task-cap overflow must be 429, not a 500"
+                )
+                assert exc.value.retry_after_s and exc.value.retry_after_s >= 1
+                assert exc.value.body["reason"] == "max-active-tasks"
+            finally:
+                gate.set()
+        finally:
+            app.stop()
+
+
+# -- the kill-the-backend drill (chaos blackout) ------------------------------
+
+
+@pytest.mark.chaos
+class TestBackendBlackoutDrill:
+    def test_breaker_opens_standing_set_served_sheds_account(self, tmp_path):
+        """ISSUE acceptance: seeded blackout while the admission queue is
+        saturated — the breaker opens (counted exactly once), liveness/
+        metrics/STATE/standing-set reads all still answer, REBALANCE returns
+        the journaled standing set marked degraded=true, queued optimize
+        work sheds 429 instead of deadlocking, detectors and the controller
+        skip with counted reasons."""
+        from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+        from cruise_control_tpu.backend import ChaosBackend, FaultPlan
+        from cruise_control_tpu.client import ClientError, CruiseControlClient
+        from cruise_control_tpu.controller.standing import (
+            ControllerJournal,
+            StandingProposalSet,
+        )
+        from cruise_control_tpu.core.journal import Journal
+
+        jdir = tmp_path / "journal"
+        # a standing proposal set, journaled as a crashed controller would
+        # have left it — the degraded path must serve exactly this
+        standing_props = [
+            ExecutionProposal(
+                tp=("T", 0), partition_size=1.0, old_leader=0,
+                old_replicas=(0, 1), new_replicas=(0, 2),
+            )
+        ]
+        cj = ControllerJournal(Journal(str(jdir / "controller")))
+        cj.published(
+            StandingProposalSet(
+                version=7, created_ms=123_000, trigger="drift", drift=2.0,
+                proposals=standing_props,
+            )
+        )
+        cj.close()
+
+        inner = seeded_backend()
+        plan = FaultPlan(seed=3)
+        chaos = ChaosBackend(inner, plan)
+        app = make_app(
+            backend=chaos,
+            **{
+                "journal.dir": str(jdir),
+                "controller.enable": True,
+                "max.active.user.tasks": 2,
+                "admission.queue.capacity": 2,
+                "admission.queue.timeout.ms": 300,
+                "breaker.failure.threshold": 3,
+                "breaker.open.ms": 60_000,
+            },
+        )
+        # the loop must never tick on its own: the drill asserts the
+        # JOURNALED set (v7) is what degraded answers serve, and a live
+        # publish would supersede it mid-test.  (The breaker-open skip
+        # outranks pause, so the forced-tick assertion below still counts.)
+        app.controller.pause("blackout drill")
+        sample_windows(app)
+        app.start(serve_http=True)
+        try:
+            c = CruiseControlClient(f"http://127.0.0.1:{app.port}")
+            assert c.healthz(readiness=True)["ready"]
+            # recovery resumed the journaled set
+            assert app.controller.standing is not None
+            assert app.controller.standing.version == 7
+
+            opens0 = counter(BREAKER_OPENS_COUNTER)
+            shed0 = counter(ADMISSION_SHED_COUNTER)
+            det_skip0 = counter(DETECTOR_BREAKER_SKIPS_COUNTER)
+            ctl_skip0 = counter(CONTROLLER_BREAKER_SKIPS_COUNTER)
+
+            # BLACKOUT: pinned deterministically at the current southbound
+            # call count — every later call raises SimulatedCrash
+            plan.crash_points["*"] = chaos.total_calls
+            for _ in range(3):
+                with pytest.raises(Exception):
+                    app.backend.describe_cluster()
+            assert app.breaker.is_open
+            assert counter(BREAKER_OPENS_COUNTER) - opens0 == 1
+
+            # liveness + observability all still answer
+            assert c.healthz()["status"] == "alive"
+            metrics = c.metrics()
+            assert "CircuitBreaker" in metrics
+            state = c.state()
+            assert state["Breaker"]["state"] == "open"
+            status = c.controller_status()
+            assert status["breakerOpen"] is True
+            assert status["standing"]["version"] == 7
+
+            # REBALANCE degrades to the journaled standing set — never
+            # blocks on the dead backend
+            t0 = time.monotonic()
+            out = c.rebalance(dryrun=True)
+            assert time.monotonic() - t0 < 5.0
+            assert out["degraded"] is True and out["breakerOpen"] is True
+            assert out["standingVersion"] == 7
+            assert out["proposals"] == [
+                {
+                    "topic": "T", "partition": 0, "oldLeader": 0,
+                    "oldReplicas": [0, 1], "newReplicas": [0, 2],
+                }
+            ]
+            # PROPOSALS (the GET of the family) degrades identically
+            out2 = c.proposals()
+            assert out2["degraded"] is True and out2["standingVersion"] == 7
+
+            # queued optimize work sheds 429 rather than deadlocking behind
+            # the dead backend: saturate the slots, then a SIMULATE (not a
+            # degradable endpoint) must shed on the queue timeout
+            held = [
+                app.admission.acquire(ANONYMOUS_PRINCIPAL, "SIMULATE")
+                for _ in range(2)
+            ]
+            try:
+                with pytest.raises(ClientError) as exc:
+                    c.simulate(load_factors=[1.1])
+                assert exc.value.status == 429
+                assert exc.value.retry_after_s and exc.value.retry_after_s >= 1
+            finally:
+                for t in held:
+                    t.release()
+            # exact shed accounting: the one refused SIMULATE
+            assert counter(ADMISSION_SHED_COUNTER) - shed0 == 1
+
+            # detectors skip their pass with a counted reason
+            detector = app.anomaly_manager.detectors[0][0]
+            assert app.anomaly_manager.run_detector_once(detector) == 0
+            assert counter(DETECTOR_BREAKER_SKIPS_COUNTER) - det_skip0 == 1
+            # the controller holds position (counted), standing set intact
+            assert app.controller.maybe_tick(force=True) is None
+            assert counter(CONTROLLER_BREAKER_SKIPS_COUNTER) - ctl_skip0 == 1
+            assert app.controller.standing.version == 7
+            # the breaker opened exactly once through all of the above
+            assert counter(BREAKER_OPENS_COUNTER) - opens0 == 1
+        finally:
+            app.stop()

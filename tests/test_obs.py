@@ -503,12 +503,15 @@ class TestGateEndToEnd:
         path = os.path.join(root, gate_mod.DEFAULT_BASELINE)
         doc = json.load(open(path))
         assert doc["schema"] == gate_mod.GATE_SCHEMA
+        artifact_baselines = {
+            # these tiers baseline against their committed bench artifacts —
+            # one number, one file, regenerated by scripts/bench_*.py
+            "controller": gate_mod._controller_baseline,
+            "serving": gate_mod._serving_baseline,
+        }
         for tier in gate_mod.DEFAULT_TIERS:
-            if tier == "controller" and tier not in doc["tiers"]:
-                # the controller tier baselines against the committed bench
-                # artifact (benchmarks/BENCH_CONTROLLER_cpu.json) — one
-                # number, one file, regenerated by scripts/bench_controller.py
-                base = gate_mod._controller_baseline(root)
+            if tier in artifact_baselines and tier not in doc["tiers"]:
+                base = artifact_baselines[tier](root)
                 assert base is not None and base["wall_s"] > 0
                 continue
             assert tier in doc["tiers"], f"no committed baseline for {tier}"
