@@ -1,14 +1,33 @@
 #!/usr/bin/env python
-"""Sharded-solver measurement: wall-clock + compiled-HLO collective counts.
+"""Sharded-solver measurement: wall-clock + goal-step collective accounting.
 
-SURVEY §7 step 5 / VERDICT r3 #8: quantify what GSPMD actually emits for the
-replica-sharded solver and compare sharded vs single-device wall-clock on the
-same host.  On the CI box the 8 mesh devices are virtual (one physical core),
-so sharded wall-clock measures *overhead*, not speedup — the honest quantity
-this script reports alongside the collective census; on a real v5e-8 the same
-script gives the speedup.
+ROADMAP #3 / ISSUE 14: quantify what the replica-sharded solver actually costs
+against the single-device solver on the same host, and pin the goal step's
+collective census so the 120-all-reduce GSPMD regression can't silently
+return.  On the CI box the 8 mesh devices are virtual (ONE physical core), so
+sharded wall-clock measures *overhead*, not speedup — every per-shard fixed
+cost runs serialized ×8, which floors the honest virtual-device ratio strictly
+above 1.0; on real multi-chip hardware the same script reports the speedup.
 
-Usage: python bench_sharded.py [--brokers N] [--partitions N] [--devices N] [--out FILE]
+Robustness contract (the MULTICHIP rc-124 fix): the artifact JSON is written
+AHEAD of every stage and refreshed after it, so even a SIGKILL from an outer
+``timeout -k`` leaves a parseable artifact with the stages that did finish and
+``"ok": false`` — never an empty file.  ``--deadline-s`` additionally stops
+between stages when the budget is spent.
+
+Stages:
+  census   — compile ONE sharded RackAware goal step; count collectives in the
+             LOGICAL program (the communication design — single-digit by
+             construction) and in the compiled HLO text (continuity with the
+             historical artifact; XLA CPU loop-widening clones inflate it);
+  single   — warm single-device optimize wall (compile run first);
+  sharded  — warm shard_map optimize wall + proposal identity + warm-recompile
+             check from the flight recorder;
+  gspmd    — optional A/B (--gspmd): the legacy auto-partitioned path's wall
+             for attribution (CC_TPU_SHARDED_SPMD=0).
+
+Usage: python bench_sharded.py [--brokers N] [--partitions N] [--rf N]
+           [--devices N] [--deadline-s S] [--gspmd] [--out FILE]
 """
 
 import argparse
@@ -18,14 +37,59 @@ import os
 import re
 import time
 
+COLLECTIVE_RE = r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+# the logical census regex is parallel.spmd.LOGICAL_COLLECTIVE_RE — imported
+# in _run() AFTER the env/platform setup (the module imports jax)
+
+
+def census(text: str, pattern: str) -> dict:
+    c = collections.Counter(m.group(1) for m in re.finditer(pattern, text))
+    return dict(sorted(c.items()))
+
+
+class Artifact:
+    """Write-ahead artifact: every mutation lands on disk immediately, so an
+    outer kill leaves the last completed stage on record instead of rc-only."""
+
+    def __init__(self, path, doc):
+        self.path = path
+        self.doc = doc
+        self.flush()
+
+    def update(self, **kw):
+        self.doc.update(kw)
+        self.flush()
+
+    def stage_done(self, name):
+        self.doc.setdefault("stages_completed", []).append(name)
+        self.flush()
+
+    def flush(self):
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.doc, f, indent=1)
+        os.replace(tmp, self.path)
+
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--brokers", type=int, default=256)
-    ap.add_argument("--partitions", type=int, default=25_000)
+    ap.add_argument("--brokers", type=int, default=32)
+    ap.add_argument("--partitions", type=int, default=6_000)
+    ap.add_argument("--rf", type=int, default=4)
+    ap.add_argument("--racks", type=int, default=4)
     ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--deadline-s", type=float, default=900.0)
+    ap.add_argument("--gspmd", action="store_true",
+                    help="also time the legacy GSPMD auto-partitioned path")
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args()
+
+    t_start = time.monotonic()
+
+    def remaining():
+        return args.deadline_s - (time.monotonic() - t_start)
 
     # virtual device mesh on CPU unless a real multi-chip backend exists
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -38,22 +102,53 @@ def main() -> None:
 
     jax.config.update("jax_platforms", "cpu")
 
+    art = Artifact(args.out, {
+        "metric": (
+            f"sharded_vs_single_wall_s_{args.brokers}brokers_"
+            f"{args.partitions}partitions_rf{args.rf}"
+        ),
+        "unit": "s",
+        "ok": False,
+        "stage": "importing",
+        "stages_completed": [],
+        "devices": args.devices,
+        "virtual_devices": True,
+        "args": {
+            "brokers": args.brokers, "partitions": args.partitions,
+            "rf": args.rf, "racks": args.racks, "devices": args.devices,
+        },
+    })
+    try:
+        _run(args, art, remaining, jax)
+    except Exception as e:  # noqa: BLE001 - the artifact IS the error channel
+        art.update(ok=False, error=f"{type(e).__name__}: {e}")
+        print(json.dumps(art.doc))
+        raise
+    print(json.dumps(art.doc))
+
+
+def _run(args, art, remaining, jax) -> None:
     from cruise_control_tpu.analyzer import GoalContext, GoalOptimizer
     from cruise_control_tpu.analyzer import goals_base as G
     from cruise_control_tpu.analyzer.goal_rounds import GOAL_ROUNDS
-    from cruise_control_tpu.analyzer.optimizer import _goal_step
-    from cruise_control_tpu.parallel import ShardedGoalOptimizer, solver_mesh
-    from cruise_control_tpu.parallel.mesh import replicate, shard_state
+    from cruise_control_tpu.obs.recorder import RECORDER
+    from cruise_control_tpu.parallel import solver_mesh
+    from cruise_control_tpu.parallel.mesh import REPLICA_AXIS, replicate, shard_state
+    from cruise_control_tpu.parallel.solver import sharded_steps
+    from cruise_control_tpu.parallel.spmd import (
+        LOGICAL_COLLECTIVE_RE,
+        SpmdInfo,
+    )
     from cruise_control_tpu.synthetic import SyntheticSpec, generate
 
     spec = SyntheticSpec(
-        num_racks=16,
+        num_racks=args.racks,
         num_brokers=args.brokers,
-        num_topics=200,
+        num_topics=100,
         num_partitions=args.partitions,
-        replication_factor=3,
+        replication_factor=args.rf,
         distribution="exponential",
-        skew_brokers=args.brokers // 4,
+        skew_brokers=max(args.brokers // 4, 1),
         mean_cpu=0.25, mean_disk=0.2, mean_nw_in=0.15, mean_nw_out=0.15,
         seed=11, build_maps=False,
     )
@@ -61,66 +156,106 @@ def main() -> None:
     ctx = GoalContext.build(state.num_topics, state.num_brokers)
     goal_ids = (G.RACK_AWARE, G.REPLICA_CAPACITY, G.DISK_CAPACITY, G.CPU_CAPACITY)
 
-    # --- collective census of one sharded goal step (RackAware) -------------
+    # --- stage: census of one sharded goal step (RackAware) -----------------
+    art.update(stage="census")
     devices = jax.devices()[: args.devices]
     mesh = solver_mesh(devices)
     sstate = shard_state(state, mesh)
     sctx = replicate(ctx, mesh)
-    lowered = _goal_step.lower(
+    spmd = SpmdInfo(
+        axis=REPLICA_AXIS, n=len(devices), global_R=sstate.num_replicas
+    )
+    steps = sharded_steps(mesh, spmd)
+    lowered = steps["goal_step"].lower(
         sstate, sctx,
         gid=G.RACK_AWARE,
         round_fns=GOAL_ROUNDS[G.RACK_AWARE],
         max_rounds=2000, enable_heavy=False,
         prior_ids=(), admit_ids=(G.RACK_AWARE,),
     )
+    logical = census(lowered.as_text(), LOGICAL_COLLECTIVE_RE)
     t0 = time.monotonic()
     compiled = lowered.compile()
     compile_s = time.monotonic() - t0
-    hlo = compiled.as_text()
-    census = collections.Counter(
-        m.group(1)
-        for m in re.finditer(
-            r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)\b",
-            hlo,
-        )
+    compiled_census = census(compiled.as_text(), COLLECTIVE_RE)
+    art.update(
+        # the LOGICAL census is the headline: collectives the program DESIGN
+        # issues per goal step (the O(1) contract); the compiled count keeps
+        # continuity with the historical artifact, inflated by XLA CPU's
+        # while-loop widening/cloning of the same logical ops
+        collectives_per_goal_step=logical,
+        collectives_per_goal_step_total=sum(logical.values()),
+        collectives_per_goal_step_compiled=compiled_census,
+        goal_step_compile_s=round(compile_s, 1),
     )
+    art.stage_done("census")
+    if remaining() <= 0:
+        art.update(stage="deadline", error="deadline before single stage")
+        return
 
-    # --- wall-clock: sharded vs single-device ------------------------------
-    def run(opt, st, cx):
-        final, result = opt.optimize(st, cx)
-        return result
-
+    # --- stage: single-device wall ------------------------------------------
+    art.update(stage="single")
     single = GoalOptimizer(goal_ids=goal_ids, enable_heavy_goals=False)
-    run(single, state, ctx)                        # compile
+    single.optimize(state, ctx)                    # compile
     t0 = time.monotonic()
-    r1 = run(single, state, ctx)
+    _, r1 = single.optimize(state, ctx)
     single_s = time.monotonic() - t0
+    art.update(
+        single_device_s=round(single_s, 3),
+        total_moves=r1.total_moves,
+        num_dispatches=r1.num_dispatches,
+    )
+    art.stage_done("single")
+    if remaining() <= 0:
+        art.update(stage="deadline", error="deadline before sharded stage")
+        return
+
+    # --- stage: sharded wall + identity + warm recompiles -------------------
+    art.update(stage="sharded")
+    from cruise_control_tpu.parallel import ShardedGoalOptimizer
 
     sharded = ShardedGoalOptimizer(
         mesh=mesh, goal_ids=goal_ids, enable_heavy_goals=False
     )
-    run(sharded, state, ctx)                       # compile
+    sharded.optimize(state, ctx)                   # compile
     t0 = time.monotonic()
-    r8 = run(sharded, state, ctx)
+    _, r8 = sharded.optimize(state, ctx)
     sharded_s = time.monotonic() - t0
+    warm_trace = next(iter(RECORDER.recent(1, kind="optimize")), None)
+    art.update(
+        value=round(sharded_s, 3),
+        overhead_x=round(sharded_s / max(single_s, 1e-9), 2),
+        proposal_identity=r1.total_moves == r8.total_moves,
+        sharded_dispatches=r8.num_dispatches,
+        warm_compile_events=(
+            len(warm_trace.compile_events) if warm_trace else None
+        ),
+        spmd_path=sharded.use_spmd,
+    )
+    art.stage_done("sharded")
 
-    out = {
-        "metric": f"sharded_vs_single_wall_s_{args.brokers}brokers_{args.partitions}partitions",
-        "value": round(sharded_s, 3),
-        "unit": "s",
-        "single_device_s": round(single_s, 3),
-        "overhead_x": round(sharded_s / max(single_s, 1e-9), 2),
-        "devices": args.devices,
-        "virtual_devices": True,
-        "collectives_per_goal_step": dict(census),
-        "goal_step_compile_s": round(compile_s, 1),
-        "proposal_identity": r1.total_moves == r8.total_moves,
-        "total_moves": r1.total_moves,
-    }
-    print(json.dumps(out))
-    if args.out:
-        with open(args.out, "w") as f:
-            json.dump(out, f, indent=1)
+    # --- stage: optional GSPMD A/B ------------------------------------------
+    if args.gspmd and remaining() > 0:
+        art.update(stage="gspmd")
+        os.environ["CC_TPU_SHARDED_SPMD"] = "0"
+        try:
+            legacy = ShardedGoalOptimizer(
+                mesh=mesh, goal_ids=goal_ids, enable_heavy_goals=False
+            )
+            legacy.optimize(state, ctx)
+            t0 = time.monotonic()
+            _, rl = legacy.optimize(state, ctx)
+            gspmd_s = time.monotonic() - t0
+            art.update(
+                gspmd_s=round(gspmd_s, 3),
+                gspmd_overhead_x=round(gspmd_s / max(single_s, 1e-9), 2),
+                gspmd_identity=rl.total_moves == r1.total_moves,
+            )
+            art.stage_done("gspmd")
+        finally:
+            os.environ.pop("CC_TPU_SHARDED_SPMD", None)
+
+    art.update(stage="done", ok=True)
 
 
 if __name__ == "__main__":

@@ -417,7 +417,20 @@ def accept_all(
 
     ``prior_mask`` is traced, so the same compiled step serves every goal position;
     disabled goals contribute a constant True.
+
+    On the sharded path the batch carries its candidate-row table
+    (``moves.rows``) — the kernels then run against the replicated surrogate
+    view with slot ids translated to table positions, touching no sharded
+    array (zero collectives; bit-identical math).
     """
+    if moves.rows is not None:
+        from cruise_control_tpu.analyzer.moves import batch_views
+
+        state, snap, r_ids, rb_ids = batch_views(state, snap, moves)
+        moves = moves.replace(
+            replica=r_ids, dst_replica=rb_ids,
+            rows=None, view_replica=None, view_dst_replica=None,
+        )
     ok = eff.valid
     for gid, fn in _KERNELS.items():
         if _off(prior_mask, gid):
@@ -630,14 +643,16 @@ def leadership_target_ok(
 
     The destination broker is the replica's own broker, so this is a per-replica
     mask rather than a matrix.  Source-side checks (the current leader losing
-    leadership) use the partition's current leader broker.
+    leadership) use the partition's current leader broker — read from the
+    snapshot's merged ``leader_broker`` table (identical values to the former
+    replica-axis gather, and shard-local under the sharded solver).
     """
     R = state.num_replicas
     p = state.replica_partition
     topic = state.partition_topic[p]
     b = state.replica_broker
     cur_leader = state.partition_leader[p]
-    leader_b = state.replica_broker[jnp.maximum(cur_leader, 0)]
+    leader_b = snap.leader_broker[p]
     ldelta = state.leadership_delta[p]          # f32[R, 4]
 
     ok = jnp.ones(R, bool)
@@ -691,6 +706,11 @@ def leadership_target_ok(
 
     # PreferredLeaderElectionGoal: only the replica-list head may take leadership
     if not _off(prior_mask, G.PREFERRED_LEADER_ELECTION):
+        if snap.spmd is not None:  # pragma: no cover - solver routes away
+            raise NotImplementedError(
+                "PreferredLeaderElectionGoal acceptance needs replica rows at "
+                "preferred-leader ids; unsupported on the shard_map path"
+            )
         pref = snap.preferred_leader[p]
         pref_safe = jnp.maximum(pref, 0)
         pref_alive = (pref >= 0) & state.broker_alive[state.replica_broker[pref_safe]]
@@ -729,12 +749,13 @@ def swap_dst_matrix(
     snap: Snapshot,
     cand: jax.Array,           # i32[S] outgoing replica per slot (clamped)
     cand_valid: jax.Array,     # bool[S]
-    partner: jax.Array,        # i32[B] incoming partner replica per dst (clamped)
-    partner_valid: jax.Array,  # bool[B]
+    partner: jax.Array,        # i32[B|M] incoming partner replica per dst (clamped)
+    partner_valid: jax.Array,  # bool[B|M]
     prior_mask: jax.Array,
+    dst_brokers: "jax.Array | None" = None,  # i32[M] restricts columns
 ) -> jax.Array:
-    """bool[S, B]: would every prior goal accept swapping ``cand[s]`` with
-    broker b's ``partner[b]``?
+    """bool[S, B|M]: would every prior goal accept swapping ``cand[s]`` with
+    the column broker's ``partner``?
 
     Unlike two bare-move checks, all threshold goals see the swap's **net**
     deltas — replica counts never change, and load checks use e_out − e_in —
@@ -743,29 +764,37 @@ def swap_dst_matrix(
     (ResourceDistributionGoal.java:599): when plain moves are vetoed.
     Per-topic swap count deltas are ignored (matching the per-slot kernel,
     which treats swaps as count-neutral).
+
+    ``dst_brokers`` restricts the destination columns (the sharded solver's
+    column slice); the caller then passes ``partner``/``partner_valid``
+    already restricted to those columns.
     """
     S = cand.shape[0]
     B = state.num_brokers
+    db = dst_brokers
+    gb = (lambda x: x) if db is None else (lambda x: x[db])
+    col_ids = jnp.arange(B, dtype=jnp.int32) if db is None else db
+    ncols = col_ids.shape[0]
     r = jnp.where(cand_valid, cand, 0)
     q = jnp.where(partner_valid, partner, 0)
     p_out = state.replica_partition[r]
     p_in = state.replica_partition[q]
     src = state.replica_broker[r]
     e_out = snap.eff_load[r]           # [S, 4]
-    e_in = snap.eff_load[q]            # [B, 4]
+    e_in = snap.eff_load[q]            # [cols, 4]
     leads_out = snap.is_leader[r]      # [S]
-    leads_in = snap.is_leader[q]       # [B]
+    leads_in = snap.is_leader[q]       # [cols]
     t_out = state.partition_topic[p_out]
     t_in = state.partition_topic[p_in]
 
-    ok = jnp.ones((S, B), bool)
+    ok = jnp.ones((S, ncols), bool)
 
     # RackAwareGoal — both directions, exact (distinct partitions); the
     # kafka-assigner mode shares the strict rack criterion
     if not _off(prior_mask, G.RACK_AWARE, G.KAFKA_ASSIGNER_RACK):
-        dst_rack = state.broker_rack[None, :]
+        dst_rack = gb(state.broker_rack)[None, :]
         src_rack = state.broker_rack[src][:, None]
-        occ_fwd = snap.rack_counts[p_out][:, state.broker_rack] - (src_rack == dst_rack).astype(jnp.int32)
+        occ_fwd = snap.rack_counts[p_out][:, gb(state.broker_rack)] - (src_rack == dst_rack).astype(jnp.int32)
         # occ_bwd[s, d] = replicas of partner[d]'s partition in slot s's source rack
         occ_bwd = (
             snap.rack_counts[p_in][:, state.broker_rack[src]].T
@@ -786,10 +815,10 @@ def swap_dst_matrix(
         c_out_src = pc[q_out, src][:, None]             # [S, 1]
         fwd = c_out_d + 1 <= c_out_src
         c_in_src = pc[q_in][:, src].T                   # [S, B]: counts[q_in_d, src_s]
-        c_in_d = pc[q_in, jnp.arange(B)][None, :]       # [1, B]
+        c_in_d = pc[q_in, col_ids][None, :]             # [1, cols]
         bwd = c_in_src + 1 <= c_in_d
         same_pos = q_out[:, None] == q_in[None, :]
-        same_broker = src[:, None] == jnp.arange(B)[None, :]  # count-neutral
+        same_broker = src[:, None] == col_ids[None, :]  # count-neutral
         ok &= jnp.where(
             prior_mask[G.KAFKA_ASSIGNER_RACK],
             same_pos | same_broker | (fwd & bwd),
@@ -805,7 +834,7 @@ def swap_dst_matrix(
         )
         prot_in = ctx.min_leader_topics[t_in]
         dst_ok = ~(prot_in & leads_in) | (
-            snap.topic_leader_counts[jnp.arange(B), t_in] - 1 >= min_l
+            snap.topic_leader_counts[col_ids, t_in] - 1 >= min_l
         )
         ok &= jnp.where(
             prior_mask[G.MIN_TOPIC_LEADERS], src_ok[:, None] & dst_ok[None, :], True
@@ -820,8 +849,8 @@ def swap_dst_matrix(
         if _off(prior_mask, gid):
             continue
         net = e_out[:, None, res] - e_in[None, :, res]      # dst gains this
-        after = snap.broker_load[None, :, res] + net
-        fits = (after <= snap.cap_limits[None, :, res]) | (net <= 0.0)
+        after = gb(snap.broker_load)[None, :, res] + net
+        fits = (after <= gb(snap.cap_limits)[None, :, res]) | (net <= 0.0)
         src_after = snap.broker_load[src, res][:, None] - net
         src_fits = (src_after <= snap.cap_limits[src, res][:, None]) | (net >= 0.0)
         ok &= jnp.where(prior_mask[gid], fits & src_fits, True)
@@ -834,16 +863,16 @@ def swap_dst_matrix(
         cap = jnp.maximum(state.broker_capacity[:, res], 1e-9)
         net = e_out[:, None, res] - e_in[None, :, res]      # dst gains this
         src_before = snap.broker_load[src, res][:, None]
-        dst_before = snap.broker_load[:, res][None, :]
+        dst_before = gb(snap.broker_load[:, res])[None, :]
         src_after = src_before - net
         dst_after = dst_before + net
         within_before = (src_before >= snap.res_lower[src, res][:, None]) & (
-            dst_before <= snap.res_upper[None, :, res]
+            dst_before <= gb(snap.res_upper)[None, :, res]
         )
-        ok_within = (dst_after <= snap.res_upper[None, :, res]) & (
+        ok_within = (dst_after <= gb(snap.res_upper)[None, :, res]) & (
             src_after >= snap.res_lower[src, res][:, None]
         )
-        ok_fb = dst_after / cap[None, :] <= src_before / cap[src][:, None]
+        ok_fb = dst_after / gb(cap)[None, :] <= src_before / cap[src][:, None]
         dist_ok = low | (net <= 0.0) | jnp.where(within_before, ok_within, ok_fb)
         ok &= jnp.where(prior_mask[gid], dist_ok, True)
 
@@ -856,14 +885,14 @@ def swap_dst_matrix(
             state.base_load[q, Resource.NW_OUT] + state.leadership_delta[p_in, Resource.NW_OUT]
         )
         pnw_net = lnw_out[:, None] - lnw_in[None, :]
-        pnw_after = snap.potential_nw_out[None, :] + pnw_net
-        pnw_ok = (pnw_after <= snap.cap_limits[None, :, Resource.NW_OUT]) | (pnw_net <= 0.0)
+        pnw_after = gb(snap.potential_nw_out)[None, :] + pnw_net
+        pnw_ok = (pnw_after <= gb(snap.cap_limits)[None, :, Resource.NW_OUT]) | (pnw_net <= 0.0)
         ok &= jnp.where(prior_mask[G.POTENTIAL_NW_OUT], pnw_ok, True)
 
     # LeaderReplicaDistributionGoal — net leader-count delta at the destination
     if not _off(prior_mask, G.LEADER_REPLICA_DIST):
         net_lead = leads_out.astype(jnp.int32)[:, None] - leads_in.astype(jnp.int32)[None, :]
-        l_after = snap.leader_counts[None, :] + net_lead
+        l_after = gb(snap.leader_counts)[None, :] + net_lead
         ld_ok = (net_lead <= 0) | (l_after <= snap.leader_band[1]) | (
             l_after <= snap.leader_counts[src][:, None] - 1
         )
@@ -874,7 +903,7 @@ def swap_dst_matrix(
         lbi_out = jnp.where(leads_out, e_out[:, Resource.NW_IN], 0.0)
         lbi_in = jnp.where(leads_in, e_in[:, Resource.NW_IN], 0.0)
         lbi_net = lbi_out[:, None] - lbi_in[None, :]
-        lbi_after = snap.leader_nw_in[None, :] + lbi_net
+        lbi_after = gb(snap.leader_nw_in)[None, :] + lbi_net
         lbi_ok = (lbi_net <= 0.0) | (lbi_after <= snap.leader_nw_in_upper) | (
             lbi_after <= snap.leader_nw_in[src][:, None]
         )

@@ -27,8 +27,15 @@ from cruise_control_tpu.core.resources import NUM_RESOURCES, Resource
 from cruise_control_tpu.model import arrays as A
 from cruise_control_tpu.model.arrays import ClusterArrays
 from cruise_control_tpu.ops.segments import segment_sum as _segment_sum
+from cruise_control_tpu.parallel.spmd import (
+    SpmdInfo,
+    global_iota,
+    merge_mins,
+    merge_sums,
+)
 
 NEG = jnp.float32(-3e38)
+_BIG = jnp.int32(2**30)
 
 
 @struct.dataclass
@@ -204,13 +211,67 @@ class Snapshot:
     topic_band: Optional[jax.Array] = None         # i32[2, T] (lower, upper)
     topic_leader_counts: Optional[jax.Array] = None  # i32[B, T]
 
+    # replica→partition aggregates shared by the leadership rounds and the
+    # SPMD slot pipeline (all merged in the one batched snapshot collective)
+    leader_broker: jax.Array = None    # i32[P] broker hosting each leader
+    leader_eff: jax.Array = None       # f32[P, 4] effective load of each leader
+    #: i32[P·racks] per-(partition, rack) min of (replica_idx << 1 | offline)
+    #: over valid members (sentinel 2**30): the group's first member AND
+    #: whether it is offline, in one packed min — rack_violating_replicas and
+    #: the RackAwareGoal violation count read both bits
+    rack_first2: jax.Array = None
+    offline_per_broker: jax.Array = None   # f32[B] offline replicas per broker
+    broker_set_need: jax.Array = None      # f32[B] broker-set violators per broker
+    rack_viol_need: jax.Array = None       # f32[B] rack-violating replicas per broker
+
     enable_heavy: bool = struct.field(pytree_node=False, default=False)
+    #: replica-axis sharding descriptor — None single-device; inside the
+    #: shard_map solver it marks per-replica fields as LOCAL shards while every
+    #: reduction field above is already merged/replicated
+    spmd: Optional[SpmdInfo] = struct.field(pytree_node=False, default=None)
 
 
-def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = False) -> Snapshot:
-    eff = A.effective_load(state)
-    lead = A.is_leader(state)
-    bload = A.broker_load(state)
+#: optional merge groups (take_snapshot ``needs``): the [P]-sized tables only
+#: some goal steps consume.  Single-device they are always computed (XLA DCEs
+#: unused outputs per program); sharded they ride the one fused collective, so
+#: fusing an unused table would defeat dead-code elimination — each goal step
+#: names exactly the groups its rounds/violations read.
+NEED_RACK_FIRST = "rack_first"    # rack_first2 (rack_violating_replicas)
+NEED_LEADER = "leader"            # leader_broker / leader_eff (leadership rounds)
+NEED_PREF = "pref"                # preferred_leader (PLE — never on the sharded path)
+NEED_BROKER_SET = "broker_set"    # broker_set_need (BrokerSetAwareGoal)
+ALL_NEEDS = frozenset({NEED_RACK_FIRST, NEED_LEADER, NEED_PREF, NEED_BROKER_SET})
+
+
+def take_snapshot(
+    state: ClusterArrays,
+    ctx: GoalContext,
+    enable_heavy: bool = False,
+    spmd: Optional[SpmdInfo] = None,
+    needs: frozenset = ALL_NEEDS,
+) -> Snapshot:
+    """Derive one round's tensors; ``spmd`` switches the replica axis to
+    local-shard mode, where EVERY replica-axis reduction below becomes a local
+    partial merged in exactly ONE batched ``psum`` plus ONE batched ``pmin``
+    (parallel.spmd) — the O(1)-collective contract of the sharded solver.
+    ``needs`` (static) trims the optional merge groups from the fused
+    collectives; a trimmed-away field is ``None`` so an unexpected consumer
+    fails loudly instead of reading a stale table."""
+    if spmd is None:
+        needs = ALL_NEEDS  # single-device: computed inline, unused ones DCE'd
+    gidx = global_iota(state, spmd)
+    if spmd is None:
+        eff = A.effective_load(state)
+        lead = A.is_leader(state)
+    else:
+        # offset-aware is_leader/effective_load: partition_leader holds GLOBAL
+        # replica indices, the local rows cover [offset, offset + R/n)
+        lead = (
+            state.partition_leader[state.replica_partition] == gidx
+        ) & state.replica_valid
+        delta_r = state.leadership_delta[state.replica_partition]
+        eff = state.base_load + jnp.where(lead[:, None], delta_r, 0.0)
+        eff = jnp.where(state.replica_valid[:, None], eff, 0.0)
     topic = state.partition_topic[state.replica_partition]
     offline = state.replica_offline_mask()
     immigrant = state.replica_broker != state.original_broker
@@ -225,8 +286,113 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         & ~offline
     )
     cap = jnp.maximum(state.broker_capacity, 1e-9)
-    replica_counts = A.broker_replica_counts(state)
-    leader_counts = A.broker_leader_counts(state)
+
+    B = state.num_brokers
+    P = state.num_partitions
+    D = state.num_disks
+    rb = state.replica_broker
+    rp = state.replica_partition
+    rvalid = state.replica_valid
+
+    # -- every replica-axis reduction, as (possibly partial) local sums/mins --
+    rack = state.broker_rack[rb]
+    group = rp * state.num_racks + rack
+    on_disk = state.replica_disk >= 0
+    # leader row fields, contributed by the shard owning partition_leader[p]
+    # (single-device: a direct gather, including the replica-row-0 read for
+    # leaderless partitions that every current call site performs)
+    ltarget = jnp.maximum(state.partition_leader, 0)
+    if spmd is None:
+        leader_broker = rb[ltarget]
+        leader_eff = eff[ltarget]
+    else:
+        loc = ltarget - spmd.offset()
+        mine = (loc >= 0) & (loc < state.num_replicas)
+        safe = jnp.where(mine, loc, 0)
+        leader_broker = jnp.where(mine, rb[safe], 0)
+        leader_eff = jnp.where(mine[:, None], eff[safe], 0.0)
+
+    sums = {
+        "bload": _segment_sum(eff, rb, num_segments=B),
+        "replica_counts": _segment_sum(rvalid.astype(jnp.int32), rb, num_segments=B),
+        "leader_counts": _segment_sum(lead.astype(jnp.int32), rb, num_segments=B),
+        "pnw": _segment_sum(
+            jnp.where(
+                rvalid,
+                state.base_load[:, Resource.NW_OUT]
+                + state.leadership_delta[rp, Resource.NW_OUT],
+                0.0,
+            ),
+            rb, num_segments=B,
+        ),
+        "lbi": _segment_sum(
+            jnp.where(lead, eff[:, Resource.NW_IN], 0.0), rb, num_segments=B
+        ),
+        "rack_counts": _segment_sum(
+            rvalid.astype(jnp.int32), group,
+            num_segments=P * state.num_racks,
+        ),
+        "dload": A.disk_load(state),
+        "d_counts": _segment_sum(
+            (on_disk & rvalid).astype(jnp.int32),
+            jnp.where(on_disk, state.replica_disk, D),
+            num_segments=max(D, 1),
+        )[:D],
+        "offline_per_broker": _segment_sum(
+            offline.astype(jnp.float32), rb, num_segments=B
+        ),
+    }
+    if NEED_LEADER in needs:
+        sums["leader_broker"] = leader_broker
+        sums["leader_eff"] = leader_eff
+    if NEED_BROKER_SET in needs:
+        want_set = ctx.broker_set_of_topic[topic]
+        have_set = ctx.broker_set_of_broker[rb]
+        bs_bad = rvalid & (want_set >= 0) & (have_set != want_set)
+        sums["broker_set_need"] = _segment_sum(
+            bs_bad.astype(jnp.float32), rb, num_segments=B
+        )
+    if enable_heavy:
+        flat_bt = rb * state.num_topics + topic
+        sums["topic_counts"] = _segment_sum(
+            rvalid.astype(jnp.int32), flat_bt,
+            num_segments=B * state.num_topics,
+        )
+        sums["topic_leader_counts"] = _segment_sum(
+            lead.astype(jnp.int32), flat_bt,
+            num_segments=B * state.num_topics,
+        )
+    # mins merge FIRST: the rack-violation per-broker need is derived from the
+    # merged group-first table and then rides the (later) fused psum — so a
+    # rack round needs NO collective beyond the snapshot's own pmin + psum
+    mins = {}
+    if NEED_PREF in needs:
+        # preferred leader = lowest valid replica index per partition
+        mins["pref"] = jax.ops.segment_min(
+            jnp.where(rvalid, gidx, _BIG), rp, num_segments=P
+        )
+    if NEED_RACK_FIRST in needs:
+        # per-(partition, rack) first member + its offline bit, packed: the
+        # index dominates the LSB so the min is the min-index member exactly
+        mins["rack_first2"] = jax.ops.segment_min(
+            jnp.where(rvalid, gidx * 2 + offline.astype(jnp.int32), _BIG),
+            group, num_segments=P * state.num_racks,
+        )
+    mins = merge_mins(spmd, mins)
+    if NEED_RACK_FIRST in needs:
+        # rack-violating rows (RackAwareGoal): for a VALID row, not being its
+        # group's first member already implies group size > 1 — no group-size
+        # table needed, so the per-broker sum can join the fused psum below
+        rack_viol = (rvalid & (gidx != mins["rack_first2"][group] // 2)) | offline
+        sums["rack_viol_need"] = _segment_sum(
+            rack_viol.astype(jnp.float32), rb, num_segments=B
+        )
+    sums = merge_sums(spmd, sums)
+
+    bload = sums["bload"]
+    replica_counts = sums["replica_counts"]
+    leader_counts = sums["leader_counts"]
+    lbi = sums["lbi"]
 
     alive = state.broker_alive
     n_alive = jnp.maximum(alive.sum(), 1)
@@ -252,25 +418,15 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         ctx.triggered_by_violation,
     )
 
-    lbi = _segment_sum(
-        jnp.where(lead, eff[:, Resource.NW_IN], 0.0),
-        state.replica_broker,
-        num_segments=state.num_brokers,
-    )
     lbi_avg = jnp.where(alive, lbi, 0.0).sum() / n_alive
     bpm = c.balance_percentage_with_margin(ctx.triggered_by_violation)
     lbi_upper = lbi_avg * (1.0 + bpm[Resource.NW_IN])
 
     # JBOD disk tensors (IntraBrokerDisk* goals; D == 0 ⇒ zero-size, no cost)
-    dload = A.disk_load(state)
+    dload = sums["dload"]
+    d_counts = sums["d_counts"]
     d_usable = state.disk_alive & (state.disk_capacity > 0.0)
     d_limit = c.resource_capacity_threshold[Resource.DISK] * state.disk_capacity
-    on_disk = state.replica_disk >= 0
-    d_counts = _segment_sum(
-        (on_disk & state.replica_valid).astype(jnp.int32),
-        jnp.where(on_disk, state.replica_disk, state.num_disks),
-        num_segments=max(state.num_disks, 1),
-    )[: state.num_disks]
     if state.num_disks > 0:
         # band around each broker's mean usable-disk utilization
         # (IntraBrokerDiskUsageDistributionGoal balances a broker's own disks)
@@ -293,18 +449,15 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         d_upper = jnp.zeros((0,), jnp.float32)
 
     # preferred leader = lowest replica index per partition (replica-list head)
-    idxR = jnp.arange(state.num_replicas, dtype=jnp.int32)
-    bigR = jnp.int32(2**30)
-    pref = jax.ops.segment_min(
-        jnp.where(state.replica_valid, idxR, bigR),
-        state.replica_partition,
-        num_segments=state.num_partitions,
+    preferred = (
+        jnp.where(mins["pref"] < _BIG, mins["pref"], -1)
+        if NEED_PREF in needs
+        else None
     )
-    preferred = jnp.where(pref < bigR, pref, -1)
 
     topic_counts = topic_band = topic_leader_counts = None
     if enable_heavy:
-        topic_counts = A.topic_replica_counts_by_broker(state)
+        topic_counts = sums["topic_counts"].reshape(B, state.num_topics)
         totals = topic_counts.sum(axis=0)
         avg_t = totals.astype(jnp.float32) / n_alive
         mult = jnp.where(ctx.triggered_by_violation, c.distribution_threshold_multiplier, 1.0)
@@ -314,11 +467,9 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         t_up = jnp.floor(avg_t).astype(jnp.int32) + gap
         t_lo = jnp.maximum(0, jnp.ceil(avg_t).astype(jnp.int32) - gap)
         topic_band = jnp.stack([t_lo, t_up])
-        flat = state.replica_broker * state.num_topics + topic
-        topic_leader_counts = _segment_sum(
-            lead.astype(jnp.int32), flat,
-            num_segments=state.num_brokers * state.num_topics,
-        ).reshape(state.num_brokers, state.num_topics)
+        topic_leader_counts = sums["topic_leader_counts"].reshape(
+            B, state.num_topics
+        )
 
     return Snapshot(
         eff_load=eff,
@@ -326,8 +477,8 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         broker_load=bload,
         replica_counts=replica_counts,
         leader_counts=leader_counts,
-        potential_nw_out=A.potential_nw_out(state),
-        rack_counts=A.replicas_per_rack_per_partition(state),
+        potential_nw_out=sums["pnw"],
+        rack_counts=sums["rack_counts"].reshape(P, state.num_racks),
         util_pct=bload / cap,
         movable=movable,
         topic_allowed=topic_allowed,
@@ -353,7 +504,14 @@ def take_snapshot(state: ClusterArrays, ctx: GoalContext, enable_heavy: bool = F
         topic_counts=topic_counts,
         topic_band=topic_band,
         topic_leader_counts=topic_leader_counts,
+        leader_broker=sums.get("leader_broker"),
+        leader_eff=sums.get("leader_eff"),
+        rack_first2=mins.get("rack_first2"),
+        offline_per_broker=sums["offline_per_broker"],
+        broker_set_need=sums.get("broker_set_need"),
+        rack_viol_need=sums.get("rack_viol_need"),
         enable_heavy=enable_heavy,
+        spmd=spmd,
     )
 
 

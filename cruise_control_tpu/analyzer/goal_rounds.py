@@ -11,6 +11,17 @@ can't recur.
 Round functions only *propose improving actions for this goal*; the optimizer layers
 final acceptance and cumulative admission on top.  All band/limit tensors come
 precomputed from the :class:`Snapshot`.
+
+Sharded-solver contract (``snap.spmd`` set): per-replica score/eligibility
+arrays passed INTO the proposers are local-shard quantities; the ``dst_fn`` /
+``fit_fn`` / ``gain_fn`` closures receive the post-merge view ``(vs, vsnap,
+cand …)`` and must derive every per-replica value from it — broker/partition/
+disk-axis tensors (bands, limits, merged counts) may still be captured, they
+are replicated either way.  ``src_need``/``dst_need`` must be REPLICATED [B]
+arrays: either pure functions of merged snapshot aggregates (most goals) or an
+explicit :func:`parallel.spmd.spmd_segment_sum` (rack-dist — one extra
+collective for exactly the rounds that need a per-replica violation sum no
+snapshot field carries).
 """
 
 from __future__ import annotations
@@ -33,7 +44,7 @@ from cruise_control_tpu.analyzer.proposers import (
 )
 from cruise_control_tpu.core.resources import Resource
 from cruise_control_tpu.model.arrays import ClusterArrays
-from cruise_control_tpu.ops.segments import segment_sum as _segment_sum
+from cruise_control_tpu.parallel.spmd import spmd_segment_sum
 
 RoundFn = Callable[[ClusterArrays, GoalContext, Snapshot, jax.Array, jax.Array], MoveBatch]
 
@@ -49,6 +60,20 @@ def _bcast(row: jax.Array, n: int) -> jax.Array:
     return jnp.broadcast_to(row[None, :], (n, row.shape[0]))
 
 
+def _c(x: jax.Array, cols) -> jax.Array:
+    """Restrict a column-axis (destination-broker) array to ``cols``.
+
+    ``cols`` is the sharded solver's column slice (proposers pass the shard's
+    own destination-broker ids so each closure BUILDS its [S, B/n] block
+    directly); ``None`` single-device — the array passes through untouched."""
+    return x if cols is None else x[cols]
+
+
+def _r_topic(vs: ClusterArrays, cand: jax.Array) -> jax.Array:
+    """i32[S]: topic of each candidate, derived from the view."""
+    return vs.partition_topic[vs.replica_partition[cand]]
+
+
 # -- offline repair (pre-phase) ----------------------------------------------------
 
 
@@ -60,26 +85,25 @@ def offline_round(
     that every goal first relocates offline replicas (self-healing semantics of
     AbstractGoal's dead-broker handling).  Destinations must be rack-safe and under
     all capacity limits so the subsequent goal phases start from a feasible point."""
-    offline_per_broker = _segment_sum(
-        snap.offline.astype(jnp.float32), state.replica_broker,
-        num_segments=state.num_brokers,
-    )
 
-    def dst_fn(cand: jax.Array):
-        p = state.replica_partition[cand]
-        src_rack = state.broker_rack[state.replica_broker[cand]]
-        occ = snap.rack_counts[p][:, state.broker_rack]  # [S, B] count in dst rack
-        occ = occ - (src_rack[:, None] == state.broker_rack[None, :]).astype(jnp.int32)
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        p = vs.replica_partition[cand]
+        src_rack = vs.broker_rack[vs.replica_broker[cand]]
+        dst_rack = _c(vs.broker_rack, cols)
+        occ = vsnap.rack_counts[p][:, dst_rack]  # [S, cols] count in dst rack
+        occ = occ - (src_rack[:, None] == dst_rack[None, :]).astype(jnp.int32)
         rack_ok = occ == 0
-        load_after = snap.broker_load[None, :, :] + snap.eff_load[cand][:, None, :]
-        fits = jnp.all(load_after <= snap.cap_limits[None, :, :], axis=-1)
-        count_ok = (snap.replica_counts + 1 <= ctx.constraint.max_replicas_per_broker)[None, :]
-        score = _bcast(-snap.util_pct.max(axis=-1), cand.shape[0])
+        load_after = _c(vsnap.broker_load, cols)[None, :, :] + vsnap.eff_load[cand][:, None, :]
+        fits = jnp.all(load_after <= _c(vsnap.cap_limits, cols)[None, :, :], axis=-1)
+        count_ok = _c(
+            vsnap.replica_counts + 1 <= ctx.constraint.max_replicas_per_broker, cols
+        )[None, :]
+        score = _bcast(_c(-vsnap.util_pct.max(axis=-1), cols), cand.shape[0])
         return rack_ok & fits & count_ok, score
 
     return shed_round(
         state, ctx, snap, prior_mask, salt,
-        src_need=offline_per_broker,
+        src_need=snap.offline_per_broker,
         cand_score=jnp.zeros(state.num_replicas, jnp.float32),
         cand_ok=snap.offline,
         dst_fn=dst_fn,
@@ -93,19 +117,15 @@ def offline_round_relaxed(
     """Fallback offline repair without rack/capacity preconditions — ensures no
     replica is stranded on a dead broker even in tight clusters (the goals then
     re-balance); only destination aliveness and partition-uniqueness are required."""
-    offline_per_broker = _segment_sum(
-        snap.offline.astype(jnp.float32), state.replica_broker,
-        num_segments=state.num_brokers,
-    )
 
-    def dst_fn(cand: jax.Array):
-        score = _bcast(-snap.util_pct.max(axis=-1), cand.shape[0])
-        elig = jnp.ones((cand.shape[0], state.num_brokers), bool)
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        score = _bcast(_c(-vsnap.util_pct.max(axis=-1), cols), cand.shape[0])
+        elig = jnp.ones(score.shape, bool)
         return elig, score
 
     return shed_round(
         state, ctx, snap, prior_mask, salt,
-        src_need=offline_per_broker,
+        src_need=snap.offline_per_broker,
         cand_score=jnp.zeros(state.num_replicas, jnp.float32),
         cand_ok=snap.offline,
         dst_fn=dst_fn,
@@ -120,16 +140,18 @@ def rack_round(
     prior_mask: jax.Array, salt: jax.Array,
 ) -> MoveBatch:
     viol = G.rack_violating_replicas(state, snap)
-    src_need = _segment_sum(
-        viol.astype(jnp.float32), state.replica_broker, num_segments=state.num_brokers
-    )
+    # per-broker violator count is a snapshot field (it rides the snapshot's
+    # fused psum, derived from the merged group-first pmin) — same integers as
+    # a fresh segment sum over ``viol``, with zero extra collectives
+    src_need = snap.rack_viol_need
 
-    def dst_fn(cand: jax.Array):
-        p = state.replica_partition[cand]
-        src_rack = state.broker_rack[state.replica_broker[cand]]
-        occ = snap.rack_counts[p][:, state.broker_rack]
-        occ = occ - (src_rack[:, None] == state.broker_rack[None, :]).astype(jnp.int32)
-        score = _bcast(-_counts_f(snap), cand.shape[0])
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        p = vs.replica_partition[cand]
+        src_rack = vs.broker_rack[vs.replica_broker[cand]]
+        dst_rack = _c(vs.broker_rack, cols)
+        occ = vsnap.rack_counts[p][:, dst_rack]
+        occ = occ - (src_rack[:, None] == dst_rack[None, :]).astype(jnp.int32)
+        score = _bcast(_c(-_counts_f(vsnap), cols), cand.shape[0])
         return occ == 0, score
 
     return shed_round(
@@ -151,9 +173,9 @@ def replica_capacity_round(
     max_r = ctx.constraint.max_replicas_per_broker
     src_need = (snap.replica_counts - max_r).astype(jnp.float32)
 
-    def dst_fn(cand: jax.Array):
-        ok = _bcast(snap.replica_counts + 1 <= max_r, cand.shape[0])
-        score = _bcast(-_counts_f(snap), cand.shape[0])
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        ok = _bcast(_c(vsnap.replica_counts + 1 <= max_r, cols), cand.shape[0])
+        score = _bcast(_c(-_counts_f(vsnap), cols), cand.shape[0])
         return ok, score
 
     return shed_round(
@@ -195,10 +217,11 @@ def _capacity_move_round(res: int) -> RoundFn:
         max_headroom = jnp.max(headroom)
         load = snap.eff_load[:, res]
 
-        def dst_fn(cand: jax.Array):
-            fits = _bcast(snap.broker_load[:, res], cand.shape[0]) + load[cand][:, None] \
-                <= _bcast(limit, cand.shape[0])
-            score = _bcast(-snap.util_pct[:, res], cand.shape[0])
+        def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+            cload = vsnap.eff_load[cand, res]
+            fits = _bcast(_c(vsnap.broker_load[:, res], cols), cand.shape[0]) \
+                + cload[:, None] <= _bcast(_c(limit, cols), cand.shape[0])
+            score = _bcast(_c(-vsnap.util_pct[:, res], cols), cand.shape[0])
             return fits, score
 
         return shed_round(
@@ -222,9 +245,9 @@ def replica_dist_shed(
     lo, up = snap.replica_band[0], snap.replica_band[1]
     src_need = (snap.replica_counts - up).astype(jnp.float32)
 
-    def dst_fn(cand: jax.Array):
-        ok = _bcast(snap.replica_counts + 1 <= up, cand.shape[0])
-        score = _bcast(-_counts_f(snap), cand.shape[0])
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        ok = _bcast(_c(vsnap.replica_counts + 1 <= up, cols), cand.shape[0])
+        score = _bcast(_c(-_counts_f(vsnap), cols), cand.shape[0])
         return ok, score
 
     return shed_round(
@@ -277,10 +300,12 @@ def replica_dist_relieve(
     # an intra-phase ping-pong that burns the round cap without converging
     dst_count_ok = (counts >= lo)[None, :]
 
-    def gain_fn(r_out: jax.Array, partner: jax.Array):
-        net = eff_disk[r_out][:, None] - eff_disk[partner][None, :]
-        src = state.replica_broker[r_out]
-        return (net > min_gain[src][:, None]) & dst_count_ok, net
+    def gain_fn(vs, vsnap, r_out: jax.Array, partner: jax.Array, cols=None):
+        e_out = vsnap.eff_load[r_out, Resource.DISK]
+        e_in = vsnap.eff_load[partner, Resource.DISK]
+        net = e_out[:, None] - e_in[None, :]
+        src = vs.replica_broker[r_out]
+        return (net > min_gain[src][:, None]) & _c(counts >= lo, cols)[None, :], net
 
     return swap_round(
         state, ctx, snap, prior_mask, salt,
@@ -301,9 +326,9 @@ def replica_dist_fill(
     dst_need = (lo - snap.replica_counts).astype(jnp.float32)
     donor_keeps = snap.replica_counts[state.replica_broker] - 1 >= lo
 
-    def fit_fn(cand: jax.Array, rows):
-        donor_counts = snap.replica_counts[state.replica_broker[cand]]
-        dst_counts = snap.replica_counts if rows is None else snap.replica_counts[rows]
+    def fit_fn(vs, vsnap, cand: jax.Array, rows):
+        donor_counts = vsnap.replica_counts[vs.replica_broker[cand]]
+        dst_counts = vsnap.replica_counts if rows is None else vsnap.replica_counts[rows]
         improves = donor_counts[None, :] >= dst_counts[:, None] + 2
         src_score = _bcast(donor_counts.astype(jnp.float32), dst_counts.shape[0])
         return improves, src_score
@@ -333,11 +358,15 @@ def potential_nw_out_round(
     headroom = jnp.where(snap.dest_ok, limit - snap.potential_nw_out, NEG)
     max_headroom = jnp.max(headroom)
 
-    def dst_fn(cand: jax.Array):
-        fits = _bcast(snap.potential_nw_out, cand.shape[0]) + leader_nw[cand][:, None] \
-            <= _bcast(limit, cand.shape[0])
-        cap = jnp.maximum(state.broker_capacity[:, Resource.NW_OUT], 1e-9)
-        score = _bcast(-(snap.potential_nw_out / cap), cand.shape[0])
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        lnw = (
+            vs.base_load[cand, Resource.NW_OUT]
+            + vs.leadership_delta[vs.replica_partition[cand], Resource.NW_OUT]
+        )
+        fits = _bcast(_c(vsnap.potential_nw_out, cols), cand.shape[0]) + lnw[:, None] \
+            <= _bcast(_c(limit, cols), cand.shape[0])
+        cap = jnp.maximum(vs.broker_capacity[:, Resource.NW_OUT], 1e-9)
+        score = _bcast(_c(-(vsnap.potential_nw_out / cap), cols), cand.shape[0])
         return fits, score
 
     return shed_round(
@@ -383,10 +412,11 @@ def _dist_shed_round(res: int) -> RoundFn:
         headroom = jnp.where(snap.dest_ok, upper - snap.broker_load[:, res], NEG)
         max_headroom = jnp.max(headroom)
 
-        def dst_fn(cand: jax.Array):
-            fits = _bcast(snap.broker_load[:, res], cand.shape[0]) + load[cand][:, None] \
-                <= _bcast(upper, cand.shape[0])
-            score = _bcast(-snap.util_pct[:, res], cand.shape[0])
+        def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+            cload = vsnap.eff_load[cand, res]
+            fits = _bcast(_c(vsnap.broker_load[:, res], cols), cand.shape[0]) \
+                + cload[:, None] <= _bcast(_c(upper, cols), cand.shape[0])
+            score = _bcast(_c(-vsnap.util_pct[:, res], cols), cand.shape[0])
             return fits, score
 
         return shed_round(
@@ -409,12 +439,13 @@ def _dist_fill_round(res: int) -> RoundFn:
         src_b = state.replica_broker
         donor_keeps = load <= snap.broker_load[src_b, res] - lower[src_b]
 
-        def fit_fn(cand: jax.Array, rows):
-            dst_load = snap.broker_load[:, res] if rows is None else snap.broker_load[rows, res]
+        def fit_fn(vs, vsnap, cand: jax.Array, rows):
+            dst_load = vsnap.broker_load[:, res] if rows is None else vsnap.broker_load[rows, res]
             dst_upper = upper if rows is None else upper[rows]
-            fits = dst_load[:, None] + load[cand][None, :] <= dst_upper[:, None]
+            cload = vsnap.eff_load[cand, res]
+            fits = dst_load[:, None] + cload[None, :] <= dst_upper[:, None]
             src_score = _bcast(
-                snap.util_pct[state.replica_broker[cand], res], dst_load.shape[0]
+                vsnap.util_pct[vs.replica_broker[cand], res], dst_load.shape[0]
             )
             return fits, src_score
 
@@ -449,12 +480,12 @@ def _swap_shed_round(res: int, capacity_bound: bool) -> RoundFn:
             src_need = jnp.where(low, 0.0, snap.broker_load[:, res] - bound)
         load = snap.eff_load[:, res]
 
-        def gain_fn(r_out, partner):
-            e_out = load[r_out][:, None]
-            e_in = load[partner][None, :]
+        def gain_fn(vs, vsnap, r_out, partner, cols=None):
+            e_out = vsnap.eff_load[r_out, res][:, None]
+            e_in = vsnap.eff_load[partner, res][None, :]
             gain = e_out - e_in                       # net load shed from the source
-            dst_after = snap.broker_load[None, :, res] + gain
-            ok = (gain > 0.0) & (dst_after <= bound[None, :])
+            dst_after = _c(vsnap.broker_load[:, res], cols)[None, :] + gain
+            ok = (gain > 0.0) & (dst_after <= _c(bound, cols)[None, :])
             return ok, gain
 
         return swap_round(
@@ -492,10 +523,11 @@ def topic_dist_round(
     r_excess = excess[state.replica_broker, topic]
     src_need = jnp.where(state.broker_alive, excess.max(axis=1), 0.0)
 
-    def dst_fn(cand: jax.Array):
-        t = topic[cand]
-        ok = bt[:, t].T + 1 <= tup[t][:, None]
-        score = -bt[:, t].T.astype(jnp.float32)
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        t = _r_topic(vs, cand)
+        btc = _c(bt, cols)
+        ok = btc[:, t].T + 1 <= tup[t][:, None]
+        score = -btc[:, t].T.astype(jnp.float32)
         return ok, score
 
     return shed_round(
@@ -536,7 +568,9 @@ def leader_dist_fill(
     dst_need = (llo - snap.leader_counts).astype(jnp.float32)
     p = state.replica_partition
     cur_leader = state.partition_leader[p]
-    leader_broker = state.replica_broker[jnp.maximum(cur_leader, 0)]
+    # snapshot's merged per-partition leader-broker table — same integers as
+    # the former replica-axis gather, shard-local under the sharded solver
+    leader_broker = snap.leader_broker[p]
     donor_rich = snap.leader_counts[leader_broker] - 1 >= llo
     return leadership_fill_round(
         state, ctx, snap, prior_mask, salt,
@@ -586,7 +620,7 @@ def min_topic_leaders_round(
 
     p = state.replica_partition
     cur_leader = state.partition_leader[p]
-    leader_broker = state.replica_broker[jnp.maximum(cur_leader, 0)]
+    leader_broker = snap.leader_broker[p]
     donor_spare = lead_bt[leader_broker, topic] - 1 >= need
     r_deficit = deficit[state.replica_broker, topic]
     return leadership_fill_round(
@@ -621,10 +655,11 @@ def intra_disk_capacity_round(
         jnp.maximum(state.replica_disk, 0)
     ]
 
-    def dst_fn(cand: jax.Array):
-        fits = snap.disk_load[None, :] + du[cand][:, None] <= snap.disk_limits[None, :]
-        cap = jnp.maximum(state.disk_capacity, 1e-9)
-        score = _bcast(-(snap.disk_load / cap), cand.shape[0])
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        cdu = vs.base_load[cand, Resource.DISK]
+        fits = vsnap.disk_load[None, :] + cdu[:, None] <= vsnap.disk_limits[None, :]
+        cap = jnp.maximum(vs.disk_capacity, 1e-9)
+        score = _bcast(-(vsnap.disk_load / cap), cand.shape[0])
         return fits, score
 
     return intra_disk_round(
@@ -648,11 +683,12 @@ def intra_disk_dist_round(
     sd = jnp.where(on_disk, state.replica_disk, 0)
     keeps_src = du <= snap.disk_load[sd] - snap.disk_lower[sd]
 
-    def dst_fn(cand: jax.Array):
-        after = snap.disk_load[None, :] + du[cand][:, None]
-        fits = after <= snap.disk_upper[None, :]
-        cap = jnp.maximum(state.disk_capacity, 1e-9)
-        score = _bcast(-(snap.disk_load / cap), cand.shape[0])
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        cdu = vs.base_load[cand, Resource.DISK]
+        after = vsnap.disk_load[None, :] + cdu[:, None]
+        fits = after <= vsnap.disk_upper[None, :]
+        cap = jnp.maximum(vs.disk_capacity, 1e-9)
+        score = _bcast(-(vsnap.disk_load / cap), cand.shape[0])
         return fits, score
 
     return intra_disk_round(
@@ -677,6 +713,11 @@ def preferred_leader_round(
     from cruise_control_tpu.analyzer.moves import KIND_LEADERSHIP
     from cruise_control_tpu.analyzer.proposers import topk_segment_argmax
 
+    if snap.spmd is not None:  # pragma: no cover - solver routes away
+        raise NotImplementedError(
+            "PreferredLeaderElectionGoal needs replica rows at preferred-leader "
+            "ids; unsupported on the shard_map path (GSPMD fallback applies)"
+        )
     B = state.num_brokers
     k = ctx.top_k
     pref = snap.preferred_leader
@@ -694,8 +735,9 @@ def preferred_leader_round(
     )
     idx = jnp.arange(state.num_replicas, dtype=jnp.int32)
     wrong = snap.is_leader & pref_usable & (pref_of_r != idx) & snap.leader_movable
-    src_need = _segment_sum(
-        wrong.astype(jnp.float32), state.replica_broker, num_segments=B
+    src_need = spmd_segment_sum(
+        snap.spmd, wrong.astype(jnp.float32), state.replica_broker,
+        num_segments=B,
     )
     cands = topk_segment_argmax(
         jnp.zeros(state.num_replicas, jnp.float32), state.replica_broker, B, wrong, k
@@ -729,17 +771,19 @@ def rack_dist_round(
     rack_of_r = state.broker_rack[state.replica_broker]
     occ_r = snap.rack_counts[p_of_r, rack_of_r]
     viol = state.replica_valid & (occ_r > fair[p_of_r])
-    src_need = _segment_sum(
-        viol.astype(jnp.float32), state.replica_broker, num_segments=state.num_brokers
+    src_need = spmd_segment_sum(
+        snap.spmd, viol.astype(jnp.float32), state.replica_broker,
+        num_segments=state.num_brokers,
     )
 
-    def dst_fn(cand: jax.Array):
-        p = state.replica_partition[cand]
-        src_rack = state.broker_rack[state.replica_broker[cand]]
-        occ = snap.rack_counts[p][:, state.broker_rack]
-        occ = occ - (src_rack[:, None] == state.broker_rack[None, :]).astype(jnp.int32)
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        p = vs.replica_partition[cand]
+        src_rack = vs.broker_rack[vs.replica_broker[cand]]
+        dst_rack = _c(vs.broker_rack, cols)
+        occ = vsnap.rack_counts[p][:, dst_rack]
+        occ = occ - (src_rack[:, None] == dst_rack[None, :]).astype(jnp.int32)
         elig = occ + 1 <= fair[p][:, None]
-        score = -occ.astype(jnp.float32) - 1e-3 * _counts_f(snap)[None, :]
+        score = -occ.astype(jnp.float32) - 1e-3 * _c(_counts_f(vsnap), cols)[None, :]
         return elig, score
 
     return shed_round(
@@ -789,14 +833,14 @@ def broker_set_round(
     want = ctx.broker_set_of_topic[topic]
     have = ctx.broker_set_of_broker[state.replica_broker]
     viol = state.replica_valid & (want >= 0) & (have != want)
-    src_need = _segment_sum(
-        viol.astype(jnp.float32), state.replica_broker, num_segments=state.num_brokers
-    )
+    # per-broker violator count is a snapshot field (merged with the batched
+    # snapshot collective) — identical values to a fresh segment sum
+    src_need = snap.broker_set_need
 
-    def dst_fn(cand: jax.Array):
-        want_c = ctx.broker_set_of_topic[topic[cand]]
-        elig = ctx.broker_set_of_broker[None, :] == want_c[:, None]
-        score = _bcast(-snap.util_pct.max(axis=-1), cand.shape[0])
+    def dst_fn(vs, vsnap, cand: jax.Array, cols=None):
+        want_c = ctx.broker_set_of_topic[_r_topic(vs, cand)]
+        elig = _c(ctx.broker_set_of_broker, cols)[None, :] == want_c[:, None]
+        score = _bcast(_c(-vsnap.util_pct.max(axis=-1), cols), cand.shape[0])
         return elig, score
 
     return shed_round(

@@ -26,6 +26,9 @@ from cruise_control_tpu.analyzer.context import GoalContext, Snapshot
 from cruise_control_tpu.core.resources import Resource
 from cruise_control_tpu.model.arrays import ClusterArrays
 from cruise_control_tpu.ops.segments import segment_sum as _segment_sum
+from cruise_control_tpu.parallel.spmd import global_iota
+
+_BIG = jnp.int32(2**30)
 
 # -- goal ids (priority-list members) ---------------------------------------------
 
@@ -89,6 +92,53 @@ GOAL_ID_BY_NAME: Dict[str, int] = {n: i for i, n in enumerate(GOAL_NAMES)}
 #: Goals needing [B, T] tensors — skipped at scale unless explicitly enabled.
 HEAVY_GOALS: Tuple[int, ...] = (MIN_TOPIC_LEADERS, TOPIC_REPLICA_DIST, TOPIC_LEADER_DIST)
 
+#: Goals whose round set includes leadership-transfer rounds (they read the
+#: snapshot's merged per-partition leader tables).
+LEADERSHIP_ROUND_GOALS: Tuple[int, ...] = (
+    MIN_TOPIC_LEADERS, NW_OUT_CAPACITY, CPU_CAPACITY,
+    NW_OUT_USAGE_DIST, CPU_USAGE_DIST,
+    LEADER_REPLICA_DIST, LEADER_BYTES_IN_DIST, TOPIC_LEADER_DIST,
+)
+
+
+def goal_snapshot_needs(gid: int) -> frozenset:
+    """Optional snapshot merge groups (context.NEED_*) goal ``gid``'s rounds,
+    acceptance terms and violation counter consume.  Static per goal id, so the
+    sharded solver's fused snapshot collective carries exactly the [P]-sized
+    tables a goal step reads — an unused table would defeat DCE inside the
+    single fused psum/pmin."""
+    from cruise_control_tpu.analyzer import context as C
+
+    n = set()
+    if gid == RACK_AWARE:
+        n.add(C.NEED_RACK_FIRST)
+    if gid in LEADERSHIP_ROUND_GOALS:
+        n.add(C.NEED_LEADER)
+    if gid == BROKER_SET_AWARE:
+        n.add(C.NEED_BROKER_SET)
+    if gid in (PREFERRED_LEADER_ELECTION, KAFKA_ASSIGNER_RACK, KAFKA_ASSIGNER_DISK):
+        # unsupported on the sharded path anyway — keep everything
+        return C.ALL_NEEDS
+    return frozenset(n)
+
+
+def violation_needs(subset) -> frozenset:
+    """Merge groups the ``violations_all`` rows of ``subset`` consume."""
+    from cruise_control_tpu.analyzer import context as C
+
+    gids = range(NUM_GOALS) if subset is None else subset
+    n = set()
+    for g in gids:
+        if g == RACK_AWARE:
+            n.add(C.NEED_RACK_FIRST)
+        elif g == BROKER_SET_AWARE:
+            n.add(C.NEED_BROKER_SET)
+        elif g == PREFERRED_LEADER_ELECTION:
+            n.add(C.NEED_PREF)
+        elif g in (KAFKA_ASSIGNER_RACK, KAFKA_ASSIGNER_DISK):
+            return C.ALL_NEEDS
+    return frozenset(n)
+
 #: Default ``hard.goals`` (AnalyzerConfig.java:337-344).
 HARD_GOALS: Tuple[int, ...] = (
     RACK_AWARE,
@@ -131,18 +181,18 @@ def rack_violating_replicas(state: ClusterArrays, snap: Snapshot) -> jax.Array:
     For each (partition, rack) group with >1 replica, every member except the
     group's first (lowest replica index) is violating.  Offline replicas are always
     violating.
+
+    Group sizes and the per-group first member come from the snapshot's merged
+    reduction fields (``rack_counts`` / ``rack_first2``) — identical integers
+    to the former in-place segment reductions, and already replicated under
+    the sharded solver so no extra collective is needed per call.
     """
     rack = state.broker_rack[state.replica_broker]
     group = state.replica_partition * state.num_racks + rack
-    n_groups = state.num_partitions * state.num_racks
-    ones = state.replica_valid.astype(jnp.int32)
-    group_size = _segment_sum(ones, group, num_segments=n_groups)
-    idx = jnp.arange(state.num_replicas, dtype=jnp.int32)
-    big = jnp.int32(2**30)
-    first = jax.ops.segment_min(
-        jnp.where(state.replica_valid, idx, big), group, num_segments=n_groups
-    )
-    crowded = (group_size[group] > 1) & (idx != first[group]) & state.replica_valid
+    gidx = global_iota(state, snap.spmd)
+    group_size = snap.rack_counts.reshape(-1)[group]
+    first = snap.rack_first2[group] // 2
+    crowded = (group_size > 1) & (gidx != first) & state.replica_valid
     return crowded | snap.offline
 
 
@@ -157,7 +207,18 @@ _EPS = 1e-6
 
 
 def _viol_rack_aware(state, ctx, snap):
-    return rack_violating_replicas(state, snap).sum().astype(jnp.float32)
+    if snap.spmd is None:
+        return rack_violating_replicas(state, snap).sum().astype(jnp.float32)
+    # sharded: count from the MERGED group tables instead of a second
+    # all-reduce over the per-replica mask.  |crowded ∪ offline| =
+    # Σ_groups max(size−1, 0)  +  #groups whose first member is offline —
+    # exactly equal integers (every offline non-first member is crowded;
+    # the only offline members not counted as crowded are group firsts).
+    sizes = snap.rack_counts.reshape(-1)
+    crowded = jnp.maximum(sizes - 1, 0).sum()
+    first2 = snap.rack_first2
+    first_off = ((first2 < _BIG) & (first2 % 2 == 1)).sum()
+    return (crowded + first_off).astype(jnp.float32)
 
 
 def _viol_replica_capacity(state, ctx, snap):
@@ -241,6 +302,12 @@ def _viol_topic_leader_dist(state, ctx, snap):
 def _viol_preferred_leader(state, ctx, snap):
     # partitions not led by their replica-list head (when the head sits on an
     # alive broker)
+    if snap.spmd is not None:  # pragma: no cover - guarded by the solver
+        raise NotImplementedError(
+            "PreferredLeaderElectionGoal is not supported on the shard_map "
+            "solver path (gathers replica rows at preferred-leader ids); "
+            "ShardedGoalOptimizer routes such goal lists to the GSPMD path"
+        )
     pref = snap.preferred_leader
     pref_safe = jnp.maximum(pref, 0)
     pref_ok = (pref >= 0) & state.broker_alive[state.replica_broker[pref_safe]]
@@ -249,20 +316,21 @@ def _viol_preferred_leader(state, ctx, snap):
 
 def _viol_rack_dist(state, ctx, snap):
     # replicas spread across racks as evenly as the alive-rack count allows
-    # (relaxed rack awareness — ceil(RF / racks) per rack)
+    # (relaxed rack awareness — ceil(RF / racks) per rack).  RF per partition
+    # is the rack-count row sum — the same integers as a fresh segment sum,
+    # with no replica-axis reduction (sharded: zero extra collectives).
     from cruise_control_tpu.analyzer.context import rack_fair_share
 
-    rf_p = _segment_sum(
-        state.replica_valid.astype(jnp.int32),
-        state.replica_partition,
-        num_segments=state.num_partitions,
-    )
+    rf_p = snap.rack_counts.sum(axis=1)
     fair = rack_fair_share(state, snap, jnp.arange(state.num_partitions))
     over = (snap.rack_counts.max(axis=1) > fair) & (rf_p > 0)
     return over.sum().astype(jnp.float32)
 
 
 def _viol_broker_set(state, ctx, snap):
+    if snap.spmd is not None:
+        # already merged per broker in the snapshot collective
+        return snap.broker_set_need.sum().astype(jnp.float32)
     r_topic = state.partition_topic[state.replica_partition]
     want_set = ctx.broker_set_of_topic[r_topic]
     have_set = ctx.broker_set_of_broker[state.replica_broker]
@@ -305,6 +373,9 @@ def assigner_position_counts(state: ClusterArrays) -> jax.Array:
     B = state.num_brokers
     pos = replica_positions(state)
     ok = state.replica_valid & (pos >= 0) & (pos < ASSIGNER_POS_CAP)
+    # (replica_positions sorts the whole replica axis — unsupported under the
+    # shard_map solver; ShardedGoalOptimizer routes assigner goal lists to the
+    # GSPMD path, so this only ever sees an unsharded axis)
     group = jnp.where(ok, pos * B + state.replica_broker, ASSIGNER_POS_CAP * B)
     return _segment_sum(
         ok.astype(jnp.int32), group, num_segments=ASSIGNER_POS_CAP * B

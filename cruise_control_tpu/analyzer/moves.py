@@ -61,6 +61,13 @@ class MoveBatch:
     #: consecutive zero-move rounds before declaring convergence; uncapped
     #: rounds leave it at 1 (one zero round proves the fixpoint).
     windows: jax.Array = dataclasses.field(default_factory=lambda: jnp.int32(1))
+    #: sharded-solver view (parallel.spmd): the replicated candidate-row table
+    #: this batch's replica/dst_replica ids were drawn from, plus each slot's
+    #: position in it.  ``None`` single-device — downstream consumers then
+    #: gather straight from the real replica axis (bit-identical either way).
+    rows: "object | None" = None            # parallel.spmd.ReplicaRows | None
+    view_replica: "jax.Array | None" = None      # i32[K] table position, -1 = hole
+    view_dst_replica: "jax.Array | None" = None  # i32[K] table position, -1 = hole
 
     @property
     def num_slots(self) -> int:
@@ -103,7 +110,25 @@ class MoveEffects:
     valid: jax.Array        # bool[K]
 
 
-def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
+def batch_views(state: ClusterArrays, snap, moves: MoveBatch):
+    """(vs, vsnap, r_ids, rb_ids): the replica-axis view this batch's slot ids
+    index into.
+
+    Single-device (``moves.rows is None``): the real state/snapshot and the
+    global ids — the exact former code path.  Sharded: the surrogate whose
+    replica axis is the batch's replicated candidate-row table, with slot ids
+    translated to table positions — the slot pipeline then runs replicated and
+    touches no sharded array.
+    """
+    if moves.rows is None:
+        return state, snap, moves.replica, moves.dst_replica
+    from cruise_control_tpu.parallel.spmd import surrogate_views
+
+    vs, vsnap = surrogate_views(state, snap, moves.rows)
+    return vs, vsnap, moves.view_replica, moves.view_dst_replica
+
+
+def move_effects(state: ClusterArrays, moves: MoveBatch, snap=None) -> MoveEffects:
     """Compute the per-broker load/count deltas of each candidate action.
 
     Leadership retention matters: a moved replica keeps (or carries) its leadership,
@@ -111,10 +136,23 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
     a replica move or swap, exactly like the reference moves the replica's whole
     ``Load`` (ClusterModel.relocateReplica:380) and transfers the leadership share on
     relocateLeadership (:409).
+
+    ``snap`` supplies the round's precomputed ``eff_load``/``is_leader`` (the
+    same formulas this function used to recompute — XLA CSE'd the duplicate
+    anyway) and, on the sharded path, the candidate-row view.
     """
+    if snap is None:
+        eff = A.effective_load(state)
+        lead = A.is_leader(state)
+        r_ids, rb_ids = moves.replica, moves.dst_replica
+        vstate = state
+    else:
+        vstate, vsnap, r_ids, rb_ids = batch_views(state, snap, moves)
+        eff = vsnap.eff_load
+        lead = vsnap.is_leader
     ok = moves.replica >= 0
-    r = jnp.where(ok, moves.replica, 0)
-    eff = A.effective_load(state)
+    r = jnp.where(ok, r_ids, 0)
+    state = vstate
     p = state.replica_partition[r]
     src = state.replica_broker[r]
 
@@ -123,7 +161,7 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
     is_lead = kind == KIND_LEADERSHIP
     is_intra = kind == KIND_INTRA_MOVE
 
-    rb = jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+    rb = jnp.where(moves.dst_replica >= 0, rb_ids, 0)
     ldelta = state.leadership_delta[p]
 
     move_src = -eff[r]
@@ -139,7 +177,6 @@ def move_effects(state: ClusterArrays, moves: MoveBatch) -> MoveEffects:
     delta_src = jnp.where(is_intra, 0.0, delta_src)
     delta_dst = jnp.where(is_intra, 0.0, delta_dst)
 
-    lead = A.is_leader(state)
     r_leads = lead[r]
     rb_leads = lead[rb] & (moves.dst_replica >= 0)
     # replica move: leader count follows the replica; leadership: -1/+1; swap: net swap
@@ -302,7 +339,8 @@ def admit(
     from cruise_control_tpu.analyzer.acceptance import accept_all
 
     if eff is None:
-        eff = move_effects(state, moves)
+        eff = move_effects(state, moves, snap)
+    vstate, _, r_ids, rb_ids = batch_views(state, snap, moves)
     keep = accepted & eff.valid
     # exactly one action per partition per round (partition-level invariants)
     keep = _keep_best_per_key(keep, eff.partition, moves.score, state.num_partitions)
@@ -313,7 +351,7 @@ def admit(
         # pre-round snapshot stay valid after the batch applies
         dd = jnp.where(keep, moves.dst_disk, 0)
         keep = _keep_best_per_key(keep, dd, moves.score, max(state.num_disks, 1))
-        src_disk = state.replica_disk[jnp.where(keep, moves.replica, 0)]
+        src_disk = vstate.replica_disk[jnp.where(keep, r_ids, 0)]
         sd = jnp.where(keep & (src_disk >= 0), src_disk, 0)
         return _keep_best_per_key(keep, sd, moves.score, max(state.num_disks, 1))
 
@@ -324,8 +362,8 @@ def admit(
         # keeps single-action acceptance against the pre-round snapshot exact
         k2 = _keep_best_per_key(keep, eff.dst_broker, moves.score, state.num_brokers)
         k2 = _keep_best_per_key(k2, eff.src_broker, moves.score, state.num_brokers)
-        dst_part = state.replica_partition[
-            jnp.where(moves.dst_replica >= 0, moves.dst_replica, 0)
+        dst_part = vstate.replica_partition[
+            jnp.where(moves.dst_replica >= 0, rb_ids, 0)
         ]
         return _keep_best_per_key(k2, dst_part, moves.score, state.num_partitions)
 
@@ -366,23 +404,70 @@ def resolve_conflicts(
     return jax.lax.cond(is_swap, _swap_dedup, lambda k: k, keep)
 
 
-def apply_moves(state: ClusterArrays, moves: MoveBatch, keep: jax.Array) -> ClusterArrays:
-    """Apply the surviving slots as batched scatters (fixed shape, jit-safe)."""
+def apply_moves(
+    state: ClusterArrays, moves: MoveBatch, keep: jax.Array, spmd=None
+) -> ClusterArrays:
+    """Apply the surviving slots as batched scatters (fixed shape, jit-safe).
+
+    Sharded (``spmd``): ids are global; each shard applies only the updates
+    landing in its contiguous replica range (out-of-range scatters drop) — the
+    ``sharded_scatter_set`` pattern, zero communication.  Partition-axis
+    updates (``partition_leader``) are replicated and derive every replica
+    attribute from the batch's row table, so all shards write identical values.
+    """
     sel = jnp.where(keep, moves.replica, -1)
+    if spmd is None:
+        sel_local = sel
+    else:
+        # global → local; foreign ids land outside [0, R_local) and drop.
+        # Holes (-1) must STAY negative: -1 - offset underflows fine, but on
+        # shard 0 offset == 0 keeps them -1 — either way ok == (sel >= 0) is
+        # preserved by keeping the sentinel explicit.
+        sel_local = jnp.where(sel >= 0, sel - spmd.offset(), -1)
 
     if moves.dst_disk is not None:
-        return A.relocate_replica_disks(state, sel, moves.dst_disk)
+        return A.relocate_replica_disks(state, sel_local, moves.dst_disk)
 
     def _apply_replica_move(state):
-        return A.relocate_replicas(state, sel, moves.dst_broker)
+        return A.relocate_replicas(state, sel_local, moves.dst_broker)
 
     def _apply_leadership(state):
-        p = jnp.where(sel >= 0, state.replica_partition[jnp.maximum(sel, 0)], -1)
+        if moves.rows is None:
+            p = jnp.where(sel >= 0, state.replica_partition[jnp.maximum(sel, 0)], -1)
+        else:
+            p = jnp.where(
+                sel >= 0,
+                moves.rows.partition[jnp.maximum(moves.view_replica, 0)],
+                -1,
+            )
         return A.relocate_leadership(state, p, moves.dst_replica)
 
     def _apply_swap(state):
         partner = jnp.where(keep, moves.dst_replica, -1)
-        return A.swap_replicas(state, sel, partner)
+        if moves.rows is None:
+            return A.swap_replicas(state, sel, partner)
+        # sharded swap: each endpoint's NEW broker comes from the row table;
+        # both scatters are owner-local (mode="drop" discards foreign ids)
+        ok = (sel >= 0) & (partner >= 0)
+        oob = jnp.int32(state.num_replicas)
+        va = jnp.maximum(moves.view_replica, 0)
+        vb = jnp.maximum(moves.view_dst_replica, 0)
+        ba = moves.rows.broker[va]
+        bb = moves.rows.broker[vb]
+        off = spmd.offset() if spmd is not None else 0
+        # ids owned by a LOWER shard go negative after the offset shift, and a
+        # negative scatter index WRAPS under mode="drop" (only >= n drops) —
+        # remap them to the oob sentinel explicitly or they'd corrupt an
+        # unrelated local row (relocate_replicas does the same remap)
+        la = sel - off
+        lb = partner - off
+        sa = jnp.where(ok & (la >= 0), la, oob)
+        sb = jnp.where(ok & (lb >= 0), lb, oob)
+        brokers = state.replica_broker.at[sa].set(bb, mode="drop")
+        brokers = brokers.at[sb].set(ba, mode="drop")
+        disks = state.replica_disk.at[sa].set(-1, mode="drop")
+        disks = disks.at[sb].set(-1, mode="drop")
+        return state.replace(replica_broker=brokers, replica_disk=disks)
 
     return jax.lax.switch(
         moves.kind, [_apply_replica_move, _apply_leadership, _apply_swap], state

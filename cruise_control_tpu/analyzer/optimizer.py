@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer import goals_base as G
 from cruise_control_tpu.analyzer.acceptance import accept_all
-from cruise_control_tpu.analyzer.context import GoalContext, take_snapshot
+from cruise_control_tpu.analyzer.context import ALL_NEEDS, GoalContext, take_snapshot
 from cruise_control_tpu.analyzer.goal_rounds import (
     GOAL_ROUNDS,
     offline_round,
@@ -382,7 +382,10 @@ def _np_mask(ids: Tuple[int, ...]):
     return m
 
 
-def _phase_loop(state, ctx, *, round_fn, max_rounds, enable_heavy, prior_ids, admit_ids):
+def _phase_loop(
+    state, ctx, *, round_fn, max_rounds, enable_heavy, prior_ids, admit_ids,
+    spmd=None, needs=None,
+):
     """Drive one round type to convergence inside a single compiled while loop.
 
     ``prior_ids`` (static) gates single-action acceptance (the hard "later
@@ -392,9 +395,16 @@ def _phase_loop(state, ctx, *, round_fn, max_rounds, enable_heavy, prior_ids, ad
     the masks become trace-time constants, so disabled goals' acceptance
     kernels are never even traced.  The round number feeds the proposers as a
     tie-breaking salt.
+
+    ``spmd`` (static, parallel.spmd.SpmdInfo) runs the body in replica-sharded
+    mode: the snapshot merges every reduction in one psum + one pmin, the
+    proposers merge candidates in one all_gather, the slot pipeline runs
+    replicated on the row table, and the apply scatters owner-locally — O(1)
+    collectives per round vs one per reduction site under GSPMD.
     """
     prior_mask = _np_mask(prior_ids)
     admit_mask = _np_mask(admit_ids)
+    snap_needs = ALL_NEEDS if needs is None else needs
 
     # With capped sources (_cap_sources) a round only offers a rotating window
     # over the need-ranked active sources; a zero-move round therefore only
@@ -406,13 +416,13 @@ def _phase_loop(state, ctx, *, round_fn, max_rounds, enable_heavy, prior_ids, ad
 
     def body(carry):
         state, it, total, streak, _ = carry
-        snap = take_snapshot(state, ctx, enable_heavy)
+        snap = take_snapshot(state, ctx, enable_heavy, spmd=spmd, needs=snap_needs)
         moves = round_fn(state, ctx, snap, prior_mask, it)
-        eff = move_effects(state, moves)
+        eff = move_effects(state, moves, snap)
         ok = moves.valid & accept_all(state, ctx, snap, moves, eff, prior_mask)
         keep = admit(state, ctx, snap, moves, ok, eff, admit_mask)
         n = keep.sum().astype(jnp.int32)
-        state = apply_moves(state, moves, keep)
+        state = apply_moves(state, moves, keep, spmd=spmd)
         streak = jnp.where(n > 0, 0, streak + 1)
         return state, it + 1, total + n, streak, moves.windows
 
@@ -447,7 +457,10 @@ def _phase_loop(state, ctx, *, round_fn, max_rounds, enable_heavy, prior_ids, ad
 #: every jit flavor registers with the executable profiler (obs/profiler.py):
 #: call counts, attributed compiles and HLO FLOPs/bytes per compiled program —
 #: pure host bookkeeping, no extra dispatches or compiles on any path
-_PHASE_STATICS = ("round_fn", "max_rounds", "enable_heavy", "prior_ids", "admit_ids")
+_PHASE_STATICS = (
+    "round_fn", "max_rounds", "enable_heavy", "prior_ids", "admit_ids", "spmd",
+    "needs",
+)
 _phase = profile_jit(
     "optimizer.phase", partial(jax.jit, static_argnames=_PHASE_STATICS)(_phase_loop)
 )
@@ -488,11 +501,13 @@ _phase_b_don = profile_jit(
 
 _GOAL_STEP_STATICS = (
     "gid", "round_fns", "max_rounds", "enable_heavy", "prior_ids", "admit_ids",
+    "spmd",
 )
 
 
 def _goal_step_fn(
-    state, ctx, *, gid, round_fns, max_rounds, enable_heavy, prior_ids, admit_ids
+    state, ctx, *, gid, round_fns, max_rounds, enable_heavy, prior_ids, admit_ids,
+    spmd=None,
 ):
     """One goal = ONE device dispatch (the default, ``fuse_goal_dispatch``):
     every round-type phase of the goal run to convergence back-to-back, plus
@@ -508,7 +523,8 @@ def _goal_step_fn(
     (GoalOptimizer.java:458-497: ``goal.optimize`` + stats bookkeeping in one
     pass).
     """
-    snap0 = take_snapshot(state, ctx, enable_heavy)
+    needs = G.goal_snapshot_needs(gid)
+    snap0 = take_snapshot(state, ctx, enable_heavy, spmd=spmd, needs=needs)
     before = G.violations_one(gid, state, ctx, snap0)
 
     # Phases repeat as a CYCLE until a full pass applies no action (or
@@ -524,7 +540,8 @@ def _goal_step_fn(
             state, r, m = _phase_loop(
                 state, ctx,
                 round_fn=fn, max_rounds=max_rounds, enable_heavy=enable_heavy,
-                prior_ids=prior_ids, admit_ids=admit_ids,
+                prior_ids=prior_ids, admit_ids=admit_ids, spmd=spmd,
+                needs=needs,
             )
             rounds += r
             moves += m
@@ -546,7 +563,7 @@ def _goal_step_fn(
             keep_going, one_pass,
             (state, jnp.int32(0), jnp.int32(0), jnp.int32(1), jnp.int32(0)),
         )
-    snap1 = take_snapshot(state, ctx, enable_heavy)
+    snap1 = take_snapshot(state, ctx, enable_heavy, spmd=spmd, needs=needs)
     after = G.violations_one(gid, state, ctx, snap1)
     return state, rounds, moves, before, after
 
@@ -622,14 +639,18 @@ def _max_replication_factor(state: ClusterArrays) -> int:
     return max(int(counts.max()), 1)
 
 
-def _violations_fn(state, ctx, enable_heavy=False, subset=None):
-    snap = take_snapshot(state, ctx, enable_heavy)
+def _violations_fn(state, ctx, enable_heavy=False, subset=None, spmd=None):
+    snap = take_snapshot(
+        state, ctx, enable_heavy, spmd=spmd, needs=G.violation_needs(subset)
+    )
     return G.violations_all(state, ctx, snap, subset=subset)
 
 
 _violations = profile_jit(
     "optimizer.violations",
-    partial(jax.jit, static_argnames=("enable_heavy", "subset"))(_violations_fn),
+    partial(jax.jit, static_argnames=("enable_heavy", "subset", "spmd"))(
+        _violations_fn
+    ),
 )
 
 
@@ -809,6 +830,27 @@ class GoalOptimizer:
     def fuse_goal_dispatch(self, value: bool) -> None:
         self._fuse_goal_dispatch = bool(value)
 
+    def _step_fns(self) -> Dict[str, object]:
+        """The jitted step executables ``_optimize_core`` dispatches.
+
+        The module-level singletons by default; ``ShardedGoalOptimizer``
+        installs shard_map-wrapped twins of the SAME traced functions
+        (``self._steps``) — the single-trace/jit-variant structure, so the
+        mesh path shares one executable per (statics, shape) across goals
+        exactly like the single-device path does."""
+        steps = getattr(self, "_steps", None)
+        if steps is not None:
+            return steps
+        return {
+            "violations": _violations,
+            "phase": _phase,
+            "phase_don": _phase_don,
+            "goal_step": _goal_step,
+            "goal_step_don": _goal_step_don,
+            "assigner": _assigner_step,
+            "assigner_don": _assigner_step_don,
+        }
+
     def violations(self, state: ClusterArrays, ctx: GoalContext):
         """Per-goal violation counts for the configured goal list — ONE
         compiled dispatch of the same ``_violations`` program every optimize
@@ -875,9 +917,13 @@ class GoalOptimizer:
         t0 = time.monotonic()
         heavy = self.enable_heavy_goals
         fused = self.fuse_goal_dispatch
+        steps = self._step_fns()
+        step_violations = steps["violations"]
         initial = state
         dispatches = 0
-        viol0 = _violations(state, ctx, enable_heavy=heavy, subset=self.goal_ids)
+        viol0 = step_violations(
+            state, ctx, enable_heavy=heavy, subset=self.goal_ids
+        )
         dispatches += 1
         stats_before = S.cluster_model_stats(state)
 
@@ -899,13 +945,13 @@ class GoalOptimizer:
         # same state); every later step consumes an intermediate we own and
         # donates its buffers
         for phase_jit, (fn, aids) in zip(
-            (_phase, _phase_don),
+            (steps["phase"], steps["phase_don"]),
             ((offline_round, hard_in_list), (offline_round_relaxed, ())),
         ):
             state, _, _ = phase_jit(
                 state, ctx,
                 round_fn=fn, max_rounds=max_rounds, enable_heavy=heavy,
-                prior_ids=(), admit_ids=aids,
+                prior_ids=(), admit_ids=aids, needs=frozenset(),
             )
             dispatches += 1
 
@@ -917,7 +963,7 @@ class GoalOptimizer:
         #    per-goal stats bookkeeping);
         #  - fused mode: one _goal_step dispatch per goal carrying its own
         #    before/after scalars, + one trailing full _violations.
-        viol_cur = None if fused else _violations(
+        viol_cur = None if fused else step_violations(
             state, ctx, enable_heavy=heavy, subset=self.goal_ids
         )
         if not fused:
@@ -962,19 +1008,21 @@ class GoalOptimizer:
                 d0 = dispatches
                 if gid == G.KAFKA_ASSIGNER_RACK:
                     # full placement mode, not an improvement loop (kafkaassigner/)
-                    state, rounds, moves, before, after, unassigned = _assigner_step_don(
+                    state, rounds, moves, before, after, unassigned = steps[
+                        "assigner_don"
+                    ](
                         state, ctx,
                         max_rf=_max_replication_factor(initial),
                         enable_heavy=heavy,
                     )
                     dispatches += 1
                     if not fused:
-                        viol_cur = _violations(
+                        viol_cur = step_violations(
                             state, ctx, enable_heavy=heavy, subset=self.goal_ids
                         )
                         dispatches += 1
                 elif fused:
-                    state, rounds, moves, before, after = _goal_step_don(
+                    state, rounds, moves, before, after = steps["goal_step_don"](
                         state, ctx,
                         gid=gid,
                         round_fns=GOAL_ROUNDS[gid],
@@ -991,7 +1039,7 @@ class GoalOptimizer:
                     for _pass in range(n_passes):
                         pass_moves = jnp.int32(0)
                         for round_fn in GOAL_ROUNDS[gid]:
-                            state, r, m = _phase_don(
+                            state, r, m = steps["phase_don"](
                                 state, ctx,
                                 round_fn=round_fn,
                                 max_rounds=max_rounds,
@@ -1007,7 +1055,7 @@ class GoalOptimizer:
                         # verdict to know whether to go again
                         if int(pass_moves) == 0:
                             break
-                    viol_cur = _violations(
+                    viol_cur = step_violations(
                         state, ctx, enable_heavy=heavy, subset=self.goal_ids
                     )
                     dispatches += 1
@@ -1044,7 +1092,9 @@ class GoalOptimizer:
                 prior = prior + (gid,)
 
             violN = (
-                _violations(state, ctx, enable_heavy=heavy, subset=self.goal_ids)
+                step_violations(
+                    state, ctx, enable_heavy=heavy, subset=self.goal_ids
+                )
                 if fused
                 else viol_cur
             )

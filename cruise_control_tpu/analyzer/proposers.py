@@ -19,6 +19,19 @@ Two details matter for liveness:
   rotate across rounds instead of deterministically re-colliding.
 
 All proposers return a :class:`MoveBatch` with ``top_k`` slots per broker.
+
+Sharded solver (``snap.spmd`` set — parallel.spmd): per-replica scoring and the
+segmented top-k run on each shard's LOCAL rows; ONE all_gather merges the
+per-shard winners (score desc, global index asc — bit-identical to the
+single-device walk) together with each winner's replica-row payload.  The slot
+pipeline below the merge — destination matrices, occupancy, prior-goal
+acceptance — then runs REPLICATED against the row table through the surrogate
+views (``vs``/``vsnap``), so one round costs O(1) collectives regardless of
+how many per-broker aggregates and gathers it performs.  The goal-round
+closures receive the view explicitly: ``dst_fn(vs, vsnap, cand)`` /
+``fit_fn(vs, vsnap, cand, rows)`` / ``gain_fn(vs, vsnap, r_out, partner)`` and
+must derive every per-replica quantity from it (never from a captured [R]
+array — that would index a local shard with a global position).
 """
 
 from __future__ import annotations
@@ -42,10 +55,13 @@ from cruise_control_tpu.analyzer.moves import (
     MoveBatch,
 )
 from cruise_control_tpu.model.arrays import ClusterArrays
+from cruise_control_tpu.parallel import spmd as SP
 
-# dst_fn(cand_replica i32[S]) -> (eligible bool[S, B], score f32[S, B]); row = slot,
-# column = destination broker.
-DstFn = Callable[[jax.Array], Tuple[jax.Array, jax.Array]]
+# dst_fn(vs, vsnap, cand_replica i32[S]) -> (eligible bool[S, B], score f32[S, B]);
+# row = slot, column = destination broker.  ``vs``/``vsnap`` are the replica-axis
+# view the candidate ids index into (the real state single-device, the merged
+# candidate-row table sharded).
+DstFn = Callable[..., Tuple[jax.Array, jax.Array]]
 
 #: Tie-break magnitude for destination choice.  Must stay below meaningful score
 #: differences (counts differ by ≥1; util fractions by ≫1e-4 when it matters).
@@ -80,12 +96,37 @@ def topk_segment_argmax(
     return jnp.stack(rows)
 
 
+def _topk_with_rows(
+    state: ClusterArrays, snap: Snapshot,
+    scores: jax.Array, seg: jax.Array, num_segments: int,
+    eligible: jax.Array, k: int,
+):
+    """(ids [k, num_segments] global, rows | None): segmented top-k on either
+    path.  Single-device: the iterative argmax walk, no row table (the state IS
+    the view).  Sharded: local top-k + one all_gather merge with row payloads."""
+    if snap.spmd is None:
+        return topk_segment_argmax(scores, seg, num_segments, eligible, k), None
+    ids, rows = SP.topk_rows_merge(
+        snap.spmd, state, snap, scores, seg, num_segments, eligible, k
+    )
+    return ids, rows
+
+
+def _views(state, snap, rows):
+    """(vs, vsnap): the replica-axis view for the slot pipeline."""
+    if rows is None:
+        return state, snap
+    return SP.surrogate_views(state, snap, rows)
+
+
 def _partition_occupancy(
     state: ClusterArrays,
-    cand: jax.Array,
+    snap: Snapshot,
+    cand_part: jax.Array,
     cand_valid: jax.Array,
     dst_brokers: "jax.Array | None" = None,
-) -> jax.Array:
+    merge: bool = True,
+):
     """bool[S, B|M]: does candidate s's partition already have a replica on the
     column's broker?
 
@@ -94,19 +135,25 @@ def _partition_occupancy(
     goal list, not just when RackAwareGoal's acceptance kernel is active.
     Cost: one scatter over R plus an [S, cols] gather; no [P, B] materialization.
 
-    ``dst_brokers`` (unique broker ids, i32[M]) restricts the columns to those
-    brokers — the capped-round path that keeps the matrix at [S, M] instead of
-    [S, B] (crucial when B is 10k).
+    ``cand_part`` is each slot's partition id (gathered from the view by the
+    caller).  Sharded: the replica scatter runs over the LOCAL rows and the
+    [S, cols] partial merges in one ``psum`` — with ``merge=False`` the caller
+    receives ``(partial, unique)`` to batch several partials into a single
+    collective (the swap round's two directions).
 
     Returns ``occupied | ~unique``: slots whose partition lost the inverse-map
     race (two candidates sharing a partition) are fully masked — they simply sit
     this round out and retry next round.
+
+    ``dst_brokers`` (unique broker ids, i32[M]) restricts the columns to those
+    brokers — the capped-round path that keeps the matrix at [S, M] instead of
+    [S, B] (crucial when B is 10k).
     """
-    S = cand.shape[0]
+    S = cand_part.shape[0]
     # slot_of_partition: P-sized inverse map, -1 for non-candidate partitions.
     # Invalid slots scatter out of bounds (dropped) so they claim no partition.
     p_oob = jnp.int32(state.num_partitions)
-    p_cand = jnp.where(cand_valid, state.replica_partition[cand], p_oob)
+    p_cand = jnp.where(cand_valid, cand_part, p_oob)
     slot = jnp.full(state.num_partitions, -1, jnp.int32)
     slot = slot.at[p_cand].set(jnp.arange(S, dtype=jnp.int32), mode="drop")
     p_safe = jnp.where(cand_valid, p_cand, 0)
@@ -124,7 +171,6 @@ def _partition_occupancy(
         col_of_broker = col_of_broker.at[dst_brokers].set(
             jnp.arange(ncols, dtype=jnp.int32)
         )
-    occupied = jnp.zeros((S, ncols), bool)
     oob = jnp.int32(S)
     rows = jnp.where((r_slot >= 0) & state.replica_valid, r_slot, oob)
     cols = (
@@ -132,8 +178,16 @@ def _partition_occupancy(
         if col_of_broker is None
         else col_of_broker[state.replica_broker]
     )
-    occupied = occupied.at[rows, cols].set(True, mode="drop")
-    return occupied | ~unique[:, None]
+    if snap.spmd is None:
+        occupied = jnp.zeros((S, ncols), bool)
+        occupied = occupied.at[rows, cols].set(True, mode="drop")
+        return occupied | ~unique[:, None]
+    partial = jnp.zeros((S, ncols), jnp.int32)
+    partial = partial.at[rows, cols].add(1, mode="drop")
+    if not merge:
+        return partial, unique
+    merged = SP.merge_sums(snap.spmd, {"occ": partial})["occ"]
+    return (merged > 0) | ~unique[:, None]
 
 
 def _cap_sources(
@@ -173,7 +227,7 @@ def shed_round(
     snap: Snapshot,
     prior_mask: jax.Array,
     salt: jax.Array,
-    src_need: jax.Array,     # f32[B] > 0 ⇒ broker must shed
+    src_need: jax.Array,     # f32[B] > 0 ⇒ broker must shed (replicated)
     cand_score: jax.Array,   # f32[R] preference among its broker's replicas
     cand_ok: jax.Array,      # bool[R]
     dst_fn: DstFn,
@@ -187,31 +241,58 @@ def shed_round(
     B = state.num_brokers
     k = ctx.top_k
     active = src_need > 0
-    cands = topk_segment_argmax(cand_score, state.replica_broker, B, cand_ok, k)
+    cands, rows = _topk_with_rows(
+        state, snap, cand_score, state.replica_broker, B, cand_ok, k
+    )
     chosen, windows = _cap_sources(src_need, ctx.max_active_brokers, salt)
     if chosen is None:
         cand = cands.reshape(-1)                               # slot = j·B + b
         src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
+        view = None if rows is None else jnp.arange(k * B, dtype=jnp.int32)
     else:
         cand = cands[:, chosen].reshape(-1)                    # slot = j·M + m
         src_of_slot = jnp.tile(chosen, k)
+        view = None if rows is None else (
+            jnp.arange(k, dtype=jnp.int32)[:, None] * B + chosen[None, :]
+        ).reshape(-1)
     S = cand.shape[0]
     valid = active[src_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
+    vs, vsnap = _views(state, snap, rows)
+    cv_safe = cand_safe if view is None else jnp.where(cand >= 0, view, 0)
+    spmd = snap.spmd
 
-    elig, score = dst_fn(cand_safe)
-    cols = jnp.arange(B, dtype=jnp.int32)
+    # occupancy is a cheap [S, B] int merge; the EXPENSIVE per-(slot, dst)
+    # broadcast work below it is column-sharded: each shard evaluates its own
+    # B/n destination columns and one small (score, col) merge picks the
+    # global destination with jnp.argmax's exact tie rule
+    occupied = _partition_occupancy(
+        state, snap, vs.replica_partition[cv_safe], valid
+    )
+    if spmd is not None and B % spmd.n == 0:
+        col0, cols, _nloc = SP.own_cols(spmd, B)
+        dst_cols = cols
+    else:
+        col0, cols, dst_cols = None, jnp.arange(B, dtype=jnp.int32), None
+
+    elig, score = dst_fn(vs, vsnap, cv_safe, dst_cols)
     not_self = cols[None, :] != src_of_slot[:, None]
-    elig = elig & snap.dest_ok[None, :] & not_self & valid[:, None]
-    elig = elig & move_dst_matrix(state, ctx, snap, cand_safe, valid, prior_mask)
+    elig = elig & snap.dest_ok[cols][None, :] & not_self & valid[:, None]
+    elig = elig & move_dst_matrix(
+        vs, ctx, vsnap, cv_safe, valid, prior_mask, dst_brokers=dst_cols
+    )
     # occupancy claims restricted to *valid* slots — an inactive broker's candidate
     # must not steal the partition slot from an active source (it would fully mask
     # the active slot via ~unique and livelock the round)
-    elig = elig & ~_partition_occupancy(state, cand_safe, valid)
+    elig = elig & ~SP.slice_cols(col0 is not None, occupied, col0, cols.shape[0])
     score = score + _pair_jitter(cand_safe[:, None], cols[None, :], salt)
     score = jnp.where(elig, score, NEG)
-    dst = jnp.argmax(score, axis=1).astype(jnp.int32)
-    found = jnp.take_along_axis(score, dst[:, None], axis=1)[:, 0] > NEG / 2
+    if col0 is None:
+        dst = jnp.argmax(score, axis=1).astype(jnp.int32)
+        found = jnp.take_along_axis(score, dst[:, None], axis=1)[:, 0] > NEG / 2
+    else:
+        best_s, dst = SP.colmax_merge(spmd, score, col0)
+        found = best_s > NEG / 2
 
     replica = jnp.where(valid & found, cand_safe, -1)
     return MoveBatch(
@@ -221,6 +302,9 @@ def shed_round(
         dst_replica=jnp.full(S, -1, jnp.int32),
         score=jnp.where(replica >= 0, src_need[src_of_slot], 0.0),
         windows=windows,
+        rows=rows,
+        view_replica=None if rows is None else jnp.where(replica >= 0, view, -1),
+        view_dst_replica=None if rows is None else jnp.full(S, -1, jnp.int32),
     )
 
 
@@ -230,11 +314,11 @@ def fill_round(
     snap: Snapshot,
     prior_mask: jax.Array,
     salt: jax.Array,
-    dst_need: jax.Array,      # f32[B] > 0 ⇒ broker wants load in
+    dst_need: jax.Array,      # f32[B] > 0 ⇒ broker wants load in (replicated)
     donor_score: jax.Array,   # f32[R] preference among a donor broker's replicas
     donor_ok: jax.Array,      # bool[R]
-    fit_fn: Callable[[jax.Array, "jax.Array | None"], Tuple[jax.Array, jax.Array]],
-    # fit_fn(cand i32[B], rows i32[M] | None)
+    fit_fn: Callable[..., Tuple[jax.Array, jax.Array]],
+    # fit_fn(vs, vsnap, cand i32[B], rows i32[M] | None)
     #   -> (fits bool[M|B, Bsrc], src_score f32[M|B, Bsrc]); row axis follows
     #   ``rows`` (destination broker ids) when given, else all brokers
 ) -> MoveBatch:
@@ -250,41 +334,77 @@ def fill_round(
     k = ctx.top_k
     active = dst_need > 0
     # top-k candidate replicas per donor broker (rotated across destinations)
-    cands_k = topk_segment_argmax(donor_score, state.replica_broker, B, donor_ok, k)
+    cands_k, tbl = _topk_with_rows(
+        state, snap, donor_score, state.replica_broker, B, donor_ok, k
+    )
+    vs, vsnap = _views(state, snap, tbl)
     cand0 = cands_k[0]
     cand0_safe = jnp.where(cand0 >= 0, cand0, 0)
+    cv0_safe = cand0_safe if tbl is None else jnp.where(
+        cand0 >= 0, jnp.arange(B, dtype=jnp.int32), 0
+    )
 
     cap_rows, windows = _cap_sources(dst_need, ctx.max_active_brokers, salt)
     row_brokers = cap_rows if cap_rows is not None else jnp.arange(B, dtype=jnp.int32)
     M = row_brokers.shape[0]
+    spmd = snap.spmd
 
-    fits, sscore = fit_fn(cand0_safe, cap_rows)   # rows = destination, cols = donor
-    cols = jnp.arange(B, dtype=jnp.int32)
-    has_cand = (cand0 >= 0)[None, :]
+    # the donor axis (columns) is the wide one — column-shard it like
+    # shed_round's destination axis: occupancy merges once at [B, M], the
+    # broadcast terms evaluate per-shard on B/n donor columns, and the
+    # per-row donor top-k merges with jnp.argmax's exact masking-walk order
+    occ_full = _partition_occupancy(
+        state, snap, vs.replica_partition[cv0_safe], cand0 >= 0,
+        dst_brokers=cap_rows,
+    )                                                      # [B donors, M]
+    if spmd is not None and B % spmd.n == 0:
+        col0, cols, nloc = SP.own_cols(spmd, B)
+        cv0_cols = jax.lax.dynamic_slice_in_dim(cv0_safe, col0, nloc)
+        c0_valid_cols = jax.lax.dynamic_slice_in_dim(cand0 >= 0, col0, nloc)
+    else:
+        col0, cols, nloc = None, jnp.arange(B, dtype=jnp.int32), B
+        cv0_cols = cv0_safe
+        c0_valid_cols = cand0 >= 0
+
+    # rows = destinations, cols = this shard's donor slice (restricted inputs
+    # make the closure build [M, B/n] directly — no reliance on slice fusion)
+    fits, sscore = fit_fn(vs, vsnap, cv0_cols, cap_rows)
+    has_cand = c0_valid_cols[None, :]
     not_self = cols[None, :] != row_brokers[:, None]
     dst_is_ok = (snap.dest_ok & active)[row_brokers][:, None]
     fits = fits & has_cand & not_self & dst_is_ok
     # [donor_slot, dst] acceptance restricted to the active destination rows —
     # [donor, M] instead of [donor, B], keeping the fill path within the
-    # top_k·M·B bound the cap promises
+    # top_k·M·B bound the cap promises (slot axis = this shard's donor slice)
     fits = fits & move_dst_matrix(
-        state, ctx, snap, cand0_safe, cand0 >= 0, prior_mask, dst_brokers=cap_rows
+        vs, ctx, vsnap, cv0_cols, c0_valid_cols, prior_mask, dst_brokers=cap_rows
     ).T
-    fits = fits & ~_partition_occupancy(
-        state, cand0_safe, cand0 >= 0, dst_brokers=cap_rows
-    ).T
+    occ = (
+        occ_full
+        if col0 is None
+        else jax.lax.dynamic_slice_in_dim(occ_full, col0, nloc, axis=0)
+    )
+    fits = fits & ~occ.T
     sscore = sscore + _pair_jitter(row_brokers[:, None], cols[None, :], salt)
     sscore = jnp.where(fits, sscore, NEG)
 
     # pick top-k donor columns per destination row
-    replicas, dsts, needs = [], [], []
+    if col0 is None:
+        donor_scores = donor_cols = None
+    else:
+        donor_scores, donor_cols = SP.coltopk_merge(spmd, sscore, col0, k)
+    replicas, views, dsts, needs = [], [], [], []
     n_cands = jnp.maximum((cands_k >= 0).sum(axis=0), 1).astype(jnp.int32)  # per donor
     rows_idx = jnp.arange(M, dtype=jnp.int32)
     masked = sscore
     for j in range(k):
-        donor = jnp.argmax(masked, axis=1).astype(jnp.int32)
-        found = jnp.take_along_axis(masked, donor[:, None], axis=1)[:, 0] > NEG / 2
-        masked = masked.at[rows_idx, donor].set(NEG)
+        if donor_cols is None:
+            donor = jnp.argmax(masked, axis=1).astype(jnp.int32)
+            found = jnp.take_along_axis(masked, donor[:, None], axis=1)[:, 0] > NEG / 2
+            masked = masked.at[rows_idx, donor].set(NEG)
+        else:
+            donor = donor_cols[j]
+            found = donor_scores[j] > NEG / 2
         # rotate which of the donor's top candidates this destination takes, so
         # two destinations sharing a donor usually receive different replicas;
         # modulo the donor's actual candidate count (cands_k is -1-padded) so a
@@ -293,9 +413,11 @@ def fill_round(
         r_j = cands_k[rot, donor]
         ok = active[row_brokers] & found & (r_j >= 0)
         replicas.append(jnp.where(ok, r_j, -1))
+        views.append(jnp.where(ok, rot * B + donor, -1))
         dsts.append(jnp.where(ok, row_brokers, -1))
         needs.append(jnp.where(ok, dst_need[row_brokers], 0.0))
     replica = jnp.concatenate(replicas)
+    viewv = jnp.concatenate(views)
     dstv = jnp.concatenate(dsts)
     need = jnp.concatenate(needs)
 
@@ -305,6 +427,7 @@ def fill_round(
     K = k * M
     slot_valid = replica >= 0
     r_safe = jnp.where(slot_valid, replica, 0)
+    rv_safe = r_safe if tbl is None else jnp.where(slot_valid, viewv, 0)
     d_safe = jnp.where(slot_valid, dstv, 0)
     slot_idx = jnp.arange(K, dtype=jnp.int32)
     # slot j·M + m targets row_brokers[m]: the restricted [K, M] matrices are
@@ -312,12 +435,13 @@ def fill_round(
     # matrices indexed at the destination broker id itself
     col = slot_idx % M if cap_rows is not None else d_safe
     pair_ok = move_dst_matrix(
-        state, ctx, snap, r_safe, slot_valid, prior_mask, dst_brokers=cap_rows
+        vs, ctx, vsnap, rv_safe, slot_valid, prior_mask, dst_brokers=cap_rows
     )[slot_idx, col]
-    pair_ok &= ~_partition_occupancy(state, r_safe, slot_valid, dst_brokers=cap_rows)[
-        slot_idx, col
-    ]
-    pair_ok &= d_safe != state.replica_broker[r_safe]
+    pair_ok &= ~_partition_occupancy(
+        state, snap, vs.replica_partition[rv_safe], slot_valid,
+        dst_brokers=cap_rows,
+    )[slot_idx, col]
+    pair_ok &= d_safe != vs.replica_broker[rv_safe]
     replica = jnp.where(slot_valid & pair_ok, replica, -1)
     return MoveBatch(
         kind=jnp.asarray(KIND_REPLICA_MOVE, jnp.int32),
@@ -326,6 +450,9 @@ def fill_round(
         dst_replica=jnp.full(K, -1, jnp.int32),
         score=jnp.where(replica >= 0, need, 0.0),
         windows=windows,
+        rows=tbl,
+        view_replica=None if tbl is None else jnp.where(replica >= 0, viewv, -1),
+        view_dst_replica=None if tbl is None else jnp.full(K, -1, jnp.int32),
     )
 
 
@@ -345,6 +472,7 @@ def leadership_shed_round(
     NW_OUT/CPU balancing, ResourceDistributionGoal.java:380)."""
     B, P = state.num_brokers, state.num_partitions
     k = ctx.top_k
+    spmd = snap.spmd
     take_ok = (
         follower_ok & snap.leader_movable & ~snap.is_leader
         & snap.topic_allowed & state.replica_valid
@@ -354,27 +482,56 @@ def leadership_shed_round(
     # partition promotes a follower on the same broker and admission throttles
     fb = state.replica_broker
     tb = _pair_jitter(state.replica_partition, fb, salt)
-    best_follower = segment_argmax(follower_score + tb, state.replica_partition, P, take_ok)
+    if spmd is None:
+        best_follower = segment_argmax(
+            follower_score + tb, state.replica_partition, P, take_ok
+        )
+    else:
+        best_follower = SP.argmax_ids_merge(
+            spmd, follower_score + tb, state.replica_partition, P, take_ok
+        )
 
     has_follower = best_follower[state.replica_partition] >= 0
     give_ok = leader_ok & snap.is_leader & has_follower
-    cands = topk_segment_argmax(leader_score, state.replica_broker, B, give_ok, k)
+    cands, leader_rows = _topk_with_rows(
+        state, snap, leader_score, state.replica_broker, B, give_ok, k
+    )
     cand = cands.reshape(-1)
     src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
     active = src_need > 0
     valid = active[src_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
-    p = state.replica_partition[cand_safe]
-    dst_rep = best_follower[p]
-    dst_rep_safe = jnp.where(dst_rep >= 0, dst_rep, 0)
+    S = cand.shape[0]
+    if spmd is None:
+        p = state.replica_partition[cand_safe]
+        dst_rep = best_follower[p]
+        dst_rep_safe = jnp.where(dst_rep >= 0, dst_rep, 0)
+        dst_broker = state.replica_broker[dst_rep_safe]
+        rows = None
+        view_r = view_d = None
+    else:
+        p = leader_rows.partition[jnp.minimum(
+            jnp.where(cand >= 0, jnp.arange(S, dtype=jnp.int32), 0), S - 1
+        )]
+        dst_rep = best_follower[p]
+        dst_rep_safe = jnp.where(dst_rep >= 0, dst_rep, 0)
+        # fetch the follower rows referenced by this round's slots (one psum)
+        follower_rows, _ = SP.fetch_rows(spmd, state, snap, dst_rep_safe)
+        dst_broker = follower_rows.broker
+        rows = SP.concat_rows([leader_rows, follower_rows])
+        view_r = jnp.arange(S, dtype=jnp.int32)
+        view_d = S + jnp.arange(S, dtype=jnp.int32)
 
     replica = jnp.where(valid & (dst_rep >= 0), cand_safe, -1)
     return MoveBatch(
         kind=jnp.asarray(KIND_LEADERSHIP, jnp.int32),
         replica=replica,
-        dst_broker=jnp.where(replica >= 0, state.replica_broker[dst_rep_safe], -1),
+        dst_broker=jnp.where(replica >= 0, dst_broker, -1),
         dst_replica=jnp.where(replica >= 0, dst_rep, -1),
         score=jnp.where(replica >= 0, src_need[src_of_slot], 0.0),
+        rows=rows,
+        view_replica=None if rows is None else jnp.where(replica >= 0, view_r, -1),
+        view_dst_replica=None if rows is None else jnp.where(replica >= 0, view_d, -1),
     )
 
 
@@ -397,13 +554,37 @@ def leadership_fill_round(
         & snap.topic_allowed & state.replica_valid
         & leadership_target_ok(state, ctx, snap, prior_mask)
     )
-    cands = topk_segment_argmax(follower_score, state.replica_broker, B, take_ok, k)
+    cands, follower_rows = _topk_with_rows(
+        state, snap, follower_score, state.replica_broker, B, take_ok, k
+    )
     cand = cands.reshape(-1)
     dst_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
     active = dst_need > 0
     valid = active[dst_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
-    p = state.replica_partition[cand_safe]
+    S = cand.shape[0]
+    if follower_rows is None:
+        p = state.replica_partition[cand_safe]
+        rows = None
+        view_r = view_d = None
+    else:
+        p = follower_rows.partition[jnp.where(
+            cand >= 0, jnp.arange(S, dtype=jnp.int32), 0
+        )]
+        # the surrendering leaders' rows come straight from the snapshot's
+        # merged per-partition leader table — no extra collective
+        leader_rows = SP.ReplicaRows(
+            partition=p,
+            broker=snap.leader_broker[p],
+            disk=jnp.full(S, -1, jnp.int32),
+            valid=state.partition_leader[p] >= 0,
+            is_leader=state.partition_leader[p] >= 0,
+            base_load=snap.leader_eff[p],
+            eff_load=snap.leader_eff[p],
+        )
+        rows = SP.concat_rows([follower_rows, leader_rows])
+        view_d = jnp.arange(S, dtype=jnp.int32)
+        view_r = S + jnp.arange(S, dtype=jnp.int32)
     cur_leader = state.partition_leader[p]
     ok = valid & (cur_leader >= 0)
 
@@ -414,6 +595,9 @@ def leadership_fill_round(
         dst_broker=jnp.where(ok, dst_of_slot, -1),
         dst_replica=jnp.where(ok, cand_safe, -1),
         score=jnp.where(ok, dst_need[dst_of_slot], 0.0),
+        rows=rows,
+        view_replica=None if rows is None else jnp.where(ok, view_r, -1),
+        view_dst_replica=None if rows is None else jnp.where(ok, view_d, -1),
     )
 
 
@@ -428,8 +612,8 @@ def swap_round(
     out_ok: jax.Array,     # bool[R]
     in_score: jax.Array,   # f32[R] preference for the incoming partner (light first)
     in_ok: jax.Array,      # bool[R]
-    gain_fn: Callable[[jax.Array, jax.Array], Tuple[jax.Array, jax.Array]],
-    # gain_fn(r_out i32[S], partner i32[B]) -> (ok bool[S, B], gain f32[S, B])
+    gain_fn: Callable[..., Tuple[jax.Array, jax.Array]],
+    # gain_fn(vs, vsnap, r_out i32[S], partner i32[B]) -> (ok bool[S, B], gain f32[S, B])
 ) -> MoveBatch:
     """One pairwise-swap round: overloaded brokers exchange a heavy replica for an
     underloaded broker's light one.
@@ -446,60 +630,116 @@ def swap_round(
     """
     B = state.num_brokers
     k = ctx.top_k
+    spmd = snap.spmd
     active = src_need > 0
 
     # one incoming partner per destination broker, rotated across rounds
     # (jitter keyed on the replica index so in-segment ties actually rotate)
-    R = state.num_replicas
-    pj = _pair_jitter(jnp.arange(R, dtype=jnp.int32), jnp.int32(97), salt)
-    partner = segment_argmax(in_score + pj, state.replica_broker, B, in_ok)
+    gidx = SP.global_iota(state, spmd)
+    pj = _pair_jitter(gidx, jnp.int32(97), salt)
+    partner_k, partner_rows = _topk_with_rows(
+        state, snap, in_score + pj, state.replica_broker, B, in_ok, 1
+    )
+    partner = partner_k[0]
     partner_valid = partner >= 0
     partner_safe = jnp.where(partner_valid, partner, 0)
-    p_in = state.replica_partition[partner_safe]
 
     # top-k outgoing replicas per active source (neediest sources when capped)
-    cands = topk_segment_argmax(out_score, state.replica_broker, B, out_ok, k)
+    cands, out_rows = _topk_with_rows(
+        state, snap, out_score, state.replica_broker, B, out_ok, k
+    )
     chosen, windows = _cap_sources(src_need, ctx.max_active_brokers, salt)
     if chosen is None:
         cand = cands.reshape(-1)
         src_of_slot = jnp.tile(jnp.arange(B, dtype=jnp.int32), k)
+        view = None if out_rows is None else jnp.arange(k * B, dtype=jnp.int32)
     else:
         cand = cands[:, chosen].reshape(-1)
         src_of_slot = jnp.tile(chosen, k)
+        view = None if out_rows is None else (
+            jnp.arange(k, dtype=jnp.int32)[:, None] * B + chosen[None, :]
+        ).reshape(-1)
     valid = active[src_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
-    p_out = state.replica_partition[cand_safe]
 
-    ok, gain = gain_fn(cand_safe, partner_safe)                 # [S, B]
-    cols = jnp.arange(B, dtype=jnp.int32)
-    not_self = cols[None, :] != src_of_slot[:, None]
-    ok = ok & partner_valid[None, :] & valid[:, None] & not_self
-    ok = ok & snap.dest_ok[None, :] & snap.dest_ok[src_of_slot][:, None]
-    ok = ok & (p_out[:, None] != p_in[None, :])
-    # occupancy both directions (a broker may hold one replica per partition)
-    ok = ok & ~_partition_occupancy(state, cand_safe, valid)
-    if chosen is None:
-        occ_in = _partition_occupancy(state, partner_safe, partner_valid)  # [B, B]
-        ok = ok & ~occ_in[:, src_of_slot].T
+    if out_rows is None:
+        vs, vsnap = state, snap
+        rows = None
+        cv_safe = cand_safe
+        pv_safe = partner_safe
+        p_out = state.replica_partition[cand_safe]
+        p_in = state.replica_partition[partner_safe]
     else:
-        # src_of_slot = tile(chosen, k): slot s targets chosen[s % M], so the
-        # restricted [B, M] occupancy is gathered at column s % M
+        rows = SP.concat_rows([out_rows, partner_rows])
+        vs, vsnap = _views(state, snap, rows)
+        cv_safe = jnp.where(cand >= 0, view, 0)
+        pv = k * B + jnp.arange(B, dtype=jnp.int32)
+        pv_safe = jnp.where(partner_valid, pv, 0)
+        p_out = vs.replica_partition[cv_safe]
+        p_in = vs.replica_partition[pv_safe]
+
+    # occupancy both directions (a broker may hold one replica per partition);
+    # sharded: both [.., cols] partials merge in ONE psum — then the WIDE
+    # per-(slot, partner-broker) work below is column-sharded like shed_round
+    if spmd is None:
+        occ_out = _partition_occupancy(state, snap, p_out, valid)
         occ_in = _partition_occupancy(
-            state, partner_safe, partner_valid, dst_brokers=chosen
+            state, snap, p_in, partner_valid, dst_brokers=chosen
         )
+    else:
+        part_out, uniq_out = _partition_occupancy(
+            state, snap, p_out, valid, merge=False
+        )
+        part_in, uniq_in = _partition_occupancy(
+            state, snap, p_in, partner_valid, dst_brokers=chosen, merge=False
+        )
+        merged = SP.merge_sums(spmd, {"out": part_out, "in": part_in})
+        occ_out = (merged["out"] > 0) | ~uniq_out[:, None]
+        occ_in = (merged["in"] > 0) | ~uniq_in[:, None]
+
+    if spmd is not None and B % spmd.n == 0:
+        col0, cols, nloc = SP.own_cols(spmd, B)
+        pv_cols = jnp.where(
+            jax.lax.dynamic_slice_in_dim(partner_valid, col0, nloc),
+            jax.lax.dynamic_slice_in_dim(pv_safe, col0, nloc), 0,
+        )
+        pvalid_cols = jax.lax.dynamic_slice_in_dim(partner_valid, col0, nloc)
+        p_in_cols = jax.lax.dynamic_slice_in_dim(p_in, col0, nloc)
+        occ_in_cols = occ_in[cols] if chosen is None else occ_in
+    else:
+        col0, cols, nloc = None, jnp.arange(B, dtype=jnp.int32), B
+        pv_cols, pvalid_cols, p_in_cols = pv_safe, partner_valid, p_in
+        occ_in_cols = occ_in
+
+    dst_cols = None if col0 is None else cols
+    ok, gain = gain_fn(vs, vsnap, cv_safe, pv_cols, dst_cols)  # [S, B|nloc]
+    not_self = cols[None, :] != src_of_slot[:, None]
+    ok = ok & pvalid_cols[None, :] & valid[:, None] & not_self
+    ok = ok & snap.dest_ok[cols][None, :] & snap.dest_ok[src_of_slot][:, None]
+    ok = ok & (p_out[:, None] != p_in_cols[None, :])
+    occ_out_c = SP.slice_cols(col0 is not None, occ_out, col0, nloc)
+    if chosen is None:
+        ok = ok & ~occ_out_c & ~occ_in_cols[:, src_of_slot].T
+    else:
         S_ = src_of_slot.shape[0]
-        ok = ok & ~occ_in[:, jnp.arange(S_, dtype=jnp.int32) % chosen.shape[0]].T
+        term = occ_in[:, jnp.arange(S_, dtype=jnp.int32) % chosen.shape[0]].T
+        ok = ok & ~occ_out_c & SP.slice_cols(col0 is not None, ~term, col0, nloc)
     # prior-goal acceptance with the swap's NET deltas — two bare-move checks
     # would veto exactly the pinned cases swaps exist for (e.g. replica counts
     # at the max: a move is rejected, a count-neutral swap is fine)
     ok = ok & swap_dst_matrix(
-        state, ctx, snap, cand_safe, valid, partner_safe, partner_valid, prior_mask
+        vs, ctx, vsnap, cv_safe, valid, pv_cols, pvalid_cols, prior_mask,
+        dst_brokers=None if col0 is None else cols,
     )
 
     score = gain + _pair_jitter(cand_safe[:, None], cols[None, :], salt)
     score = jnp.where(ok, score, NEG)
-    dst = jnp.argmax(score, axis=1).astype(jnp.int32)
-    found = jnp.take_along_axis(score, dst[:, None], axis=1)[:, 0] > NEG / 2
+    if col0 is None:
+        dst = jnp.argmax(score, axis=1).astype(jnp.int32)
+        found = jnp.take_along_axis(score, dst[:, None], axis=1)[:, 0] > NEG / 2
+    else:
+        best_s, dst = SP.colmax_merge(spmd, score, col0)
+        found = best_s > NEG / 2
 
     replica = jnp.where(valid & found, cand_safe, -1)
     dst_safe = jnp.where(replica >= 0, dst, 0)
@@ -510,6 +750,11 @@ def swap_round(
         dst_replica=jnp.where(replica >= 0, partner[dst_safe], -1),
         score=jnp.where(replica >= 0, src_need[src_of_slot], 0.0),
         windows=windows,
+        rows=rows,
+        view_replica=None if rows is None else jnp.where(replica >= 0, view, -1),
+        view_dst_replica=None if rows is None else jnp.where(
+            replica >= 0, k * B + dst_safe, -1
+        ),
     )
 
 
@@ -522,7 +767,7 @@ def intra_disk_round(
     src_need: jax.Array,     # f32[D] > 0 ⇒ logdir must shed
     cand_score: jax.Array,   # f32[R] preference among the disk's replicas
     cand_ok: jax.Array,      # bool[R]
-    dst_fn: DstFn,           # dst_fn(cand i32[S]) -> (elig bool[S, D], score f32[S, D])
+    dst_fn: DstFn,           # dst_fn(vs, vsnap, cand i32[S]) -> (elig, score) [S, D]
 ) -> MoveBatch:
     """One intra-broker logdir-move round (IntraBrokerDisk* goals).
 
@@ -537,22 +782,30 @@ def intra_disk_round(
     on_disk = state.replica_disk >= 0
     seg = jnp.where(on_disk, state.replica_disk, D)
     active = src_need > 0
-    cands = topk_segment_argmax(cand_score, seg, D, cand_ok & on_disk, k)
+    cands, rows = _topk_with_rows(
+        state, snap, cand_score, seg, D, cand_ok & on_disk, k
+    )
     chosen, windows = _cap_sources(src_need, ctx.max_active_brokers, salt)
     if chosen is None:
         cand = cands.reshape(-1)
         src_disk_of_slot = jnp.tile(jnp.arange(D, dtype=jnp.int32), k)
+        view = None if rows is None else jnp.arange(k * D, dtype=jnp.int32)
     else:
         cand = cands[:, chosen].reshape(-1)
         src_disk_of_slot = jnp.tile(chosen, k)
+        view = None if rows is None else (
+            jnp.arange(k, dtype=jnp.int32)[:, None] * D + chosen[None, :]
+        ).reshape(-1)
     S = cand.shape[0]
     valid = active[src_disk_of_slot] & (cand >= 0)
     cand_safe = jnp.where(cand >= 0, cand, 0)
+    vs, vsnap = _views(state, snap, rows)
+    cv_safe = cand_safe if view is None else jnp.where(cand >= 0, view, 0)
 
-    elig, score = dst_fn(cand_safe)
+    elig, score = dst_fn(vs, vsnap, cv_safe)
     cols = jnp.arange(D, dtype=jnp.int32)
     same_broker = (
-        state.disk_broker[None, :] == state.replica_broker[cand_safe][:, None]
+        state.disk_broker[None, :] == vs.replica_broker[cv_safe][:, None]
     )
     not_self = cols[None, :] != src_disk_of_slot[:, None]
     elig = elig & same_broker & not_self & snap.disk_usable[None, :] & valid[:, None]
@@ -565,9 +818,12 @@ def intra_disk_round(
     return MoveBatch(
         kind=jnp.asarray(KIND_INTRA_MOVE, jnp.int32),
         replica=replica,
-        dst_broker=jnp.where(replica >= 0, state.replica_broker[cand_safe], -1),
+        dst_broker=jnp.where(replica >= 0, vs.replica_broker[cv_safe], -1),
         dst_replica=jnp.full(S, -1, jnp.int32),
         score=jnp.where(replica >= 0, src_need[src_disk_of_slot], 0.0),
         dst_disk=jnp.where(replica >= 0, dst, -1),
         windows=windows,
+        rows=rows,
+        view_replica=None if rows is None else jnp.where(replica >= 0, view, -1),
+        view_dst_replica=None if rows is None else jnp.full(S, -1, jnp.int32),
     )

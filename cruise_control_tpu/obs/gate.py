@@ -60,6 +60,9 @@ class GateThresholds:
     wall_floor_s: float = 0.25
     max_extra_dispatches: int = 0
     max_balancedness_drop: float = 1.0
+    #: absolute allowance on the sharded tier's overhead ratio (sharded /
+    #: single-device warm wall) — sub-second warm walls make the ratio jumpy
+    overhead_floor: float = 0.75
 
 
 @dataclasses.dataclass(frozen=True)
@@ -315,6 +318,159 @@ def _run_serving_tier(inject_sleep_s: float = 0.0) -> dict:
     }
 
 
+_SHARDED_ARTIFACT = os.path.join("benchmarks", "BENCH_SHARDED_8dev_virtual.json")
+#: the O(1)-collective contract: a sharded goal step's LOGICAL program must
+#: stay single-digit (the GSPMD regression this gate exists to refuse was 120)
+_SHARDED_MAX_COLLECTIVES = 9
+
+
+def _run_sharded_tier(inject_sleep_s: float = 0.0) -> dict:
+    """Replica-sharded solver tier: O(1)-collective census + identity + walls.
+
+    ISSUE 14: the committed ``benchmarks/BENCH_SHARDED_8dev_virtual.json``
+    records the sharded solver's contract — single-digit logical collectives
+    per goal step, proposal identity with the single-device solver, zero warm
+    recompiles.  This tier re-measures all three LIVE at a gate-affordable
+    shape (the census by *lowering* one sharded RackAware goal step — no XLA
+    compile — so collective growth is caught in seconds) and validates the
+    committed artifact itself, so neither the code nor the artifact can
+    silently rot.  The gated wall is the warm sharded solve; ``overhead_x``
+    (sharded / single-device warm wall on the same host) is additionally
+    compared against the committed GATE_BASELINE entry — on the 1-core CI box
+    the 8 mesh devices are virtual, so the ratio measures serialization
+    overhead and any growth means the communication design regressed."""
+    _force_cpu_platform()
+    import re
+
+    import jax
+
+    if jax.device_count() < 8:
+        raise RuntimeError(
+            f"sharded tier needs 8 devices, have {jax.device_count()} "
+            "(child process sets --xla_force_host_platform_device_count=8)"
+        )
+    from cruise_control_tpu.analyzer import GoalOptimizer
+    from cruise_control_tpu.analyzer import goals_base as G
+    from cruise_control_tpu.analyzer.goal_rounds import GOAL_ROUNDS
+    from cruise_control_tpu.obs.recorder import RECORDER
+    from cruise_control_tpu.parallel import ShardedGoalOptimizer, solver_mesh
+    from cruise_control_tpu.parallel.mesh import (
+        REPLICA_AXIS,
+        replicate,
+        shard_state,
+    )
+    from cruise_control_tpu.parallel.solver import sharded_steps
+    from cruise_control_tpu.parallel.spmd import (
+        LOGICAL_COLLECTIVE_RE,
+        SpmdInfo,
+    )
+
+    state, ctx = _synthetic(
+        num_racks=4, num_brokers=12, num_topics=8, num_partitions=1500,
+        replication_factor=3, distribution="exponential", skew_brokers=3,
+        mean_cpu=0.25, mean_disk=0.2, mean_nw_in=0.15, mean_nw_out=0.15,
+        seed=13,
+    )
+    goals = (G.RACK_AWARE, G.REPLICA_CAPACITY, G.DISK_CAPACITY)
+
+    # committed-artifact contract first: a broken artifact fails the gate even
+    # if the live code is healthy — it is the evidence future claims cite
+    errors: List[str] = []
+    art: dict = {}
+    art_path = os.path.join(_repo_root(), _SHARDED_ARTIFACT)
+    try:
+        with open(art_path) as f:
+            art = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        errors.append(f"committed {_SHARDED_ARTIFACT} unreadable: {e}")
+    if art:
+        if not art.get("ok"):
+            errors.append(f"committed {_SHARDED_ARTIFACT} has ok != true")
+        if art.get("proposal_identity") is not True:
+            errors.append("committed artifact proposal_identity != true")
+        if art.get("warm_compile_events") not in (0, None):
+            errors.append(
+                f"committed artifact warm_compile_events = "
+                f"{art.get('warm_compile_events')} (must be 0)"
+            )
+        art_census = art.get("collectives_per_goal_step_total")
+        if art_census is None or art_census > _SHARDED_MAX_COLLECTIVES:
+            errors.append(
+                f"committed artifact collectives_per_goal_step_total "
+                f"{art_census} > {_SHARDED_MAX_COLLECTIVES} (single-digit "
+                "contract)"
+            )
+
+    # live census: LOWER one sharded RackAware goal step (no XLA compile) and
+    # count the collectives the program design issues
+    mesh = solver_mesh(jax.devices()[:8])
+    sstate = shard_state(state, mesh)
+    sctx = replicate(ctx, mesh)
+    spmd = SpmdInfo(
+        axis=REPLICA_AXIS, n=8, global_R=sstate.num_replicas
+    )
+    lowered = sharded_steps(mesh, spmd)["goal_step"].lower(
+        sstate, sctx,
+        gid=G.RACK_AWARE, round_fns=GOAL_ROUNDS[G.RACK_AWARE],
+        max_rounds=2000, enable_heavy=False,
+        prior_ids=(), admit_ids=(G.RACK_AWARE,),
+    )
+    census = len(re.findall(LOGICAL_COLLECTIVE_RE, lowered.as_text()))
+    if census > _SHARDED_MAX_COLLECTIVES:
+        errors.append(
+            f"live sharded goal step lowers with {census} collectives > "
+            f"{_SHARDED_MAX_COLLECTIVES} (the per-reduction-site regression)"
+        )
+    art_census = art.get("collectives_per_goal_step_total")
+    if art_census is not None and census > art_census:
+        errors.append(
+            f"live census {census} > committed artifact's {art_census} "
+            "(collective-count growth)"
+        )
+
+    # walls + identity: warm single-device vs warm sharded on the same host
+    kw = dict(
+        goal_ids=goals,
+        hard_ids=tuple(g for g in goals if g in G.HARD_GOALS),
+        enable_heavy_goals=False,
+    )
+    single = GoalOptimizer(**kw)
+    single.optimize(state, ctx)                 # compile
+    t0 = time.monotonic()
+    _, r1 = single.optimize(state, ctx)
+    single_s = time.monotonic() - t0
+    sh = ShardedGoalOptimizer(mesh=mesh, **kw)
+    if not sh.use_spmd:
+        errors.append("sharded optimizer did not take the shard_map path")
+    sh.optimize(state, ctx)                     # compile
+    t0 = time.monotonic()
+    _, r8 = sh.optimize(state, ctx)
+    sharded_s = time.monotonic() - t0
+    if inject_sleep_s:
+        time.sleep(inject_sleep_s)
+        sharded_s += inject_sleep_s
+    trace = next(iter(RECORDER.recent(1, kind="optimize")), None)
+    warm_c = len(trace.compile_events) if trace else None
+    if r1.total_moves != r8.total_moves:
+        errors.append(
+            f"proposal identity broken: sharded {r8.total_moves} moves != "
+            f"single-device {r1.total_moves}"
+        )
+    if errors:
+        return {"tier": "sharded", "error": "; ".join(errors)}
+    return {
+        "tier": "sharded",
+        "platform": "cpu",
+        "wall_s": round(sharded_s, 4),
+        "single_device_s": round(single_s, 4),
+        "overhead_x": round(sharded_s / max(single_s, 1e-9), 2),
+        "collectives_per_goal_step": census,
+        "warm_compile_events": warm_c,
+        "total_moves": r8.total_moves,
+        "sharded_dispatches": r8.num_dispatches,
+    }
+
+
 def _serving_baseline(root: str) -> Optional[dict]:
     """Gate baseline for the serving tier, derived from the committed bench
     artifact (``benchmarks/BENCH_SERVING_cpu.json``) — same single-source
@@ -365,10 +521,15 @@ TIERS: Dict[str, GateTier] = {
                  "contract vs BENCH_SERVING_cpu.json",
                  build=None, bench_comparable=False,
                  runner=_run_serving_tier),
+        GateTier("sharded", "replica-sharded solver: O(1)-collective census + "
+                 "proposal identity vs BENCH_SHARDED_8dev_virtual.json",
+                 build=None, bench_comparable=False, needs_devices=8,
+                 runner=_run_sharded_tier),
     )
 }
 DEFAULT_TIERS = (
     "config1", "config2_small", "mesh8", "exporter", "controller", "serving",
+    "sharded",
 )
 
 
@@ -505,6 +666,22 @@ def compare(
             f"{tier}: balancedness {measured['balancedness']:.2f} < baseline "
             f"{base_bal:.2f} − {thresholds.max_balancedness_drop}"
         )
+
+    # sharded tier: overhead ratio (sharded / single-device warm wall) must
+    # not grow past the committed baseline — wall_s alone can mask a
+    # communication regression when the whole box got faster or slower
+    base_ov = baseline.get("overhead_x")
+    if base_ov is not None and measured.get("overhead_x") is not None:
+        allowed_ov = base_ov * thresholds.max_wall_ratio * wall_slack + (
+            thresholds.overhead_floor
+        )
+        if measured["overhead_x"] > allowed_ov:
+            failures.append(
+                f"{tier}: overhead_x {measured['overhead_x']:.2f} exceeds "
+                f"{allowed_ov:.2f} (baseline {base_ov:.2f} × "
+                f"{thresholds.max_wall_ratio} × slack {wall_slack} + "
+                f"{thresholds.overhead_floor} floor)"
+            )
 
     span_sum = measured.get("span_dispatch_sum", -1)
     if span_sum >= 0 and span_sum != measured["num_dispatches"]:
@@ -713,6 +890,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         elif "series" in m:   # exporter tier gates render wall only
             status = f"wall={m['wall_s']}s series={m.get('series')}"
+        elif "overhead_x" in m:   # sharded tier: census + identity + overhead
+            status = (
+                f"wall={m['wall_s']}s overhead_x={m.get('overhead_x')} "
+                f"collectives={m.get('collectives_per_goal_step')} "
+                f"warm_compiles={m.get('warm_compile_events')}"
+            )
         elif "goodput_rps" in m:   # serving tier: admitted p95 + shed contract
             status = (
                 f"p95_admitted={m['wall_s']}s admitted={m.get('admitted')} "

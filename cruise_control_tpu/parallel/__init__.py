@@ -1,7 +1,9 @@
 """Scale-out layer: device mesh, replica-axis sharding, collective primitives.
 
-See ``parallel.mesh`` (layout), ``parallel.sharded`` (explicit shard_map/psum
-primitives), ``parallel.solver`` (the mesh-sharded GoalOptimizer).
+See ``parallel.mesh`` (layout), ``parallel.spmd`` (batched-collective SPMD
+support consulted by the solver kernels inside shard_map), ``parallel.sharded``
+(explicit shard_map/psum primitives), ``parallel.solver`` (the mesh-sharded
+GoalOptimizer).
 """
 
 from cruise_control_tpu.parallel.mesh import (
@@ -11,7 +13,6 @@ from cruise_control_tpu.parallel.mesh import (
     shard_state,
     solver_mesh,
 )
-from cruise_control_tpu.parallel.solver import ShardedGoalOptimizer
 
 __all__ = [
     "REPLICA_AXIS",
@@ -21,3 +22,15 @@ __all__ = [
     "shard_state",
     "solver_mesh",
 ]
+
+
+def __getattr__(name):
+    # lazy: parallel.solver imports analyzer.optimizer, whose modules import
+    # parallel.spmd — resolving the solver on first attribute access (PEP 562)
+    # keeps `from cruise_control_tpu.parallel import ShardedGoalOptimizer`
+    # working without making the package import cyclic
+    if name == "ShardedGoalOptimizer":
+        from cruise_control_tpu.parallel.solver import ShardedGoalOptimizer
+
+        return ShardedGoalOptimizer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,39 +1,214 @@
 """Mesh-sharded goal optimizer — the scale-out production solver.
 
-``ShardedGoalOptimizer`` runs the exact solver of ``analyzer.optimizer`` with the
-cluster state sharded over a device mesh (``parallel.mesh`` layout: replica axis
-data-parallel, broker/partition axes replicated).  The phase kernels are already
-jitted; calling them with sharded operands makes XLA's SPMD partitioner emit the
-collective program — per-broker segment reductions become per-shard partials +
-all-reduce over ICI, candidate gathers become one-hot reductions — matching the
-explicit shard_map forms in ``parallel.sharded`` (which pin down and unit-test
-the intended communication pattern).
+``ShardedGoalOptimizer`` runs the exact solver of ``analyzer.optimizer`` with
+the cluster state sharded over a device mesh (``parallel.mesh`` layout: replica
+axis data-parallel, broker/partition axes replicated).
 
-Correctness contract (tests/test_parallel.py): proposals computed on an n-device
-mesh are identical to the single-device run — sharding is an execution detail,
-never a semantics change.  This is the component the reference cannot express:
-its analyzer is a single-JVM sequential walk (GoalOptimizer.java:435-524, scale
-ceiling ~10k brokers at minutes of wall clock); here the same goal semantics run
-SPMD over every chip of a slice.
+Two execution modes:
 
-Telemetry: the sharded path dispatches the SAME profiled jit objects as the
-single-device optimizer (``obs/profiler.py`` wraps them at module level), so
-``/METRICS`` reports its per-program call counts, attributed compiles and
-HLO cost under the same ``optimizer.*`` program names — sharded-input
-signatures simply appear as additional shape entries, and the per-device
-``memory_stats()`` gauges cover every mesh device at trace boundaries.
+* **shard_map (default)** — the O(1)-collective path.  The SAME traced step
+  functions (``_phase_loop`` / ``_goal_step_fn`` / ``_violations_fn``) run
+  inside an explicit ``shard_map`` with ``PartitionSpec("replicas")`` on every
+  replica-axis leaf; a static :class:`parallel.spmd.SpmdInfo` switches the
+  kernels to local-shard mode, where a goal-step round costs ONE batched
+  ``psum`` + ONE batched ``pmin`` (every snapshot reduction), ONE
+  ``all_gather`` (candidate top-k merge, bit-identical tie-breaking), and ONE
+  ``psum`` (occupancy/row fetch) — single-digit collectives per compiled goal
+  step, vs the ~120 all-reduces GSPMD auto-partitioning emitted for the same
+  step (benchmarks/BENCH_SHARDED_8dev_virtual.json history).  Plain and
+  donating jit variants wrap ONE traced kernel per step type, so the mesh path
+  shares executables across goals exactly like the single-device path.
+
+* **GSPMD fallback** — the former behavior (jit the plain steps on sharded
+  operands, XLA partitions automatically).  Used for goal lists the SPMD
+  kernels don't support (PreferredLeaderElectionGoal and the kafka-assigner
+  goals need replica-row gathers/sorts outside the candidate tables) and via
+  ``CC_TPU_SHARDED_SPMD=0`` for A/B attribution.
+
+Correctness contract (tests/test_parallel.py): proposals computed on an
+n-device mesh are identical to the single-device run — sharding is an
+execution detail, never a semantics change.
+
+Telemetry: the shard_map variants register with the executable profiler under
+``optimizer.sharded_*`` program names (call counts, attributed compile walls,
+HLO cost), so /METRICS separates mesh-path executables from single-device
+ones; the GSPMD fallback keeps dispatching the single-device programs.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+import os
+from functools import partial
+from typing import Dict, Optional
 
-from jax.sharding import Mesh
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # jax ≥ 0.4.35 exports shard_map from jax.experimental; newer jax from jax
+    from jax.experimental.shard_map import shard_map
+except ImportError:  # pragma: no cover - exercised only on newer jax
+    from jax import shard_map
+
+from cruise_control_tpu.analyzer import goals_base as G
 from cruise_control_tpu.analyzer.context import GoalContext
-from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.analyzer.optimizer import (
+    GoalOptimizer,
+    _goal_step_fn,
+    _phase_loop,
+    _violations_fn,
+)
 from cruise_control_tpu.model.arrays import ClusterArrays
-from cruise_control_tpu.parallel.mesh import replicate, shard_state, solver_mesh
+from cruise_control_tpu.obs.profiler import profile_jit
+from cruise_control_tpu.parallel.mesh import (
+    REPLICA_AXIS,
+    REPLICA_FIELDS,
+    replicate,
+    shard_state,
+    solver_mesh,
+)
+from cruise_control_tpu.parallel.spmd import SpmdInfo
+
+#: goals whose kernels need replica-axis work outside the merged candidate
+#: tables (whole-axis sorts, gathers at preferred-leader ids) — goal lists
+#: containing any of these run on the GSPMD fallback path
+UNSUPPORTED_SPMD_GOALS = frozenset(
+    {G.PREFERRED_LEADER_ELECTION, G.KAFKA_ASSIGNER_RACK, G.KAFKA_ASSIGNER_DISK}
+)
+
+_PHASE_STATICS = (
+    "round_fn", "max_rounds", "enable_heavy", "prior_ids", "admit_ids", "needs",
+)
+_GOAL_STEP_STATICS = (
+    "gid", "round_fns", "max_rounds", "enable_heavy", "prior_ids", "admit_ids",
+)
+
+
+def _state_specs(state: ClusterArrays) -> ClusterArrays:
+    """A ClusterArrays-shaped pytree of PartitionSpecs: replica leaves sharded
+    ``P("replicas")``, everything else replicated.  Static fields copy the
+    input's values so the treedef matches exactly."""
+    kw = {}
+    for f in dataclasses.fields(ClusterArrays):
+        v = getattr(state, f.name)
+        if f.metadata.get("pytree_node", True) is False or isinstance(v, int):
+            kw[f.name] = v
+            continue
+        ndim = getattr(v, "ndim", 0)
+        if f.name in REPLICA_FIELDS:
+            kw[f.name] = P(REPLICA_AXIS, *([None] * (ndim - 1)))
+        else:
+            kw[f.name] = P(*([None] * ndim))
+    return ClusterArrays(**kw)
+
+
+def _sharded_steps(mesh: Mesh, spmd: SpmdInfo) -> Dict[str, object]:
+    """shard_map-wrapped plain/donating jit variants of the one traced step set.
+
+    Keyed per (mesh, spmd) by the caller; each wrapper builds its shard_map at
+    trace time (the in/out specs need the concrete state treedef) and is jitted
+    with the same static names as the single-device twins, so executables are
+    shared across goals through the identical (statics, shape) cache key.
+    """
+
+    def _phase_stepped(
+        state, ctx, *, round_fn, max_rounds, enable_heavy, prior_ids, admit_ids,
+        needs=None,
+    ):
+        spec = _state_specs(state)
+        kernel = partial(
+            _phase_loop,
+            round_fn=round_fn, max_rounds=max_rounds, enable_heavy=enable_heavy,
+            prior_ids=prior_ids, admit_ids=admit_ids, spmd=spmd, needs=needs,
+        )
+        return shard_map(
+            kernel, mesh=mesh,
+            in_specs=(spec, P()), out_specs=(spec, P(), P()),
+            check_rep=False,
+        )(state, ctx)
+
+    def _goal_stepped(
+        state, ctx, *, gid, round_fns, max_rounds, enable_heavy, prior_ids,
+        admit_ids,
+    ):
+        spec = _state_specs(state)
+        kernel = partial(
+            _goal_step_fn,
+            gid=gid, round_fns=round_fns, max_rounds=max_rounds,
+            enable_heavy=enable_heavy, prior_ids=prior_ids,
+            admit_ids=admit_ids, spmd=spmd,
+        )
+        return shard_map(
+            kernel, mesh=mesh,
+            in_specs=(spec, P()), out_specs=(spec, P(), P(), P(), P()),
+            check_rep=False,
+        )(state, ctx)
+
+    def _violations_stepped(state, ctx, enable_heavy=False, subset=None):
+        spec = _state_specs(state)
+        kernel = lambda s, c: _violations_fn(
+            s, c, enable_heavy, subset, spmd=spmd
+        )
+        return shard_map(
+            kernel, mesh=mesh,
+            in_specs=(spec, P()), out_specs=P(),
+            check_rep=False,
+        )(state, ctx)
+
+    def _assigner_unsupported(*a, **kw):  # pragma: no cover - routed away
+        raise NotImplementedError(
+            "kafka-assigner goals run on the GSPMD fallback path"
+        )
+
+    return {
+        "violations": profile_jit(
+            "optimizer.sharded_violations",
+            partial(jax.jit, static_argnames=("enable_heavy", "subset"))(
+                _violations_stepped
+            ),
+        ),
+        "phase": profile_jit(
+            "optimizer.sharded_phase",
+            partial(jax.jit, static_argnames=_PHASE_STATICS)(_phase_stepped),
+        ),
+        "phase_don": profile_jit(
+            "optimizer.sharded_phase",
+            partial(
+                jax.jit, static_argnames=_PHASE_STATICS, donate_argnums=(0,)
+            )(_phase_stepped),
+        ),
+        "goal_step": profile_jit(
+            "optimizer.sharded_goal_step",
+            partial(jax.jit, static_argnames=_GOAL_STEP_STATICS)(_goal_stepped),
+        ),
+        "goal_step_don": profile_jit(
+            "optimizer.sharded_goal_step",
+            partial(
+                jax.jit, static_argnames=_GOAL_STEP_STATICS, donate_argnums=(0,)
+            )(_goal_stepped),
+        ),
+        "assigner": _assigner_unsupported,
+        "assigner_don": _assigner_unsupported,
+    }
+
+
+#: one step set per (mesh, spmd) — executables are cached inside the jits, the
+#: dict only avoids re-wrapping (and re-registering profiler entries)
+_STEP_CACHE: Dict[object, Dict[str, object]] = {}
+
+
+def sharded_steps(mesh: Mesh, spmd: SpmdInfo) -> Dict[str, object]:
+    key = (mesh, spmd)
+    steps = _STEP_CACHE.get(key)
+    if steps is None:
+        steps = _sharded_steps(mesh, spmd)
+        _STEP_CACHE[key] = steps
+    return steps
+
+
+def spmd_supported(goal_ids) -> bool:
+    """Whether the shard_map fast path covers this goal list."""
+    return not (set(goal_ids) & UNSUPPORTED_SPMD_GOALS)
 
 
 class ShardedGoalOptimizer(GoalOptimizer):
@@ -42,6 +217,15 @@ class ShardedGoalOptimizer(GoalOptimizer):
     def __init__(self, mesh: Optional[Mesh] = None, **kwargs) -> None:
         super().__init__(**kwargs)
         self.mesh = mesh if mesh is not None else solver_mesh()
+        self._steps = None
+
+    @property
+    def use_spmd(self) -> bool:
+        """shard_map fast path enabled (goal list supported + not disabled via
+        ``CC_TPU_SHARDED_SPMD=0`` — the A/B switch for collective attribution)."""
+        if os.environ.get("CC_TPU_SHARDED_SPMD", "1") in ("0", "false"):
+            return False
+        return spmd_supported(self.goal_ids)
 
     def optimize(self, state: ClusterArrays, ctx: GoalContext, maps=None, **kw):
         # bucket BEFORE sharding: padding is host-side numpy, so running it on
@@ -50,5 +234,15 @@ class ShardedGoalOptimizer(GoalOptimizer):
         state, ctx, unbucket = self._bucketed(state, ctx)
         state = shard_state(state, self.mesh)
         ctx = replicate(ctx, self.mesh)
-        final, result = self._optimize_core(state, ctx, maps=maps, **kw)
+        if self.use_spmd:
+            spmd = SpmdInfo(
+                axis=REPLICA_AXIS,
+                n=int(self.mesh.devices.size),
+                global_R=state.num_replicas,  # post-pad (multiple of n)
+            )
+            self._steps = sharded_steps(self.mesh, spmd)
+        try:
+            final, result = self._optimize_core(state, ctx, maps=maps, **kw)
+        finally:
+            self._steps = None
         return unbucket(final), result

@@ -45,7 +45,11 @@ python -m pytest tests/test_admission.py -x -q
 echo "== serving bench (200 concurrent clients: shed contract + admitted-p95 vs committed baseline) =="
 python scripts/bench_serving.py >/dev/null
 
-echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check) =="
+echo "== sharded tier (O(1)-collective census, replica-axis equivalence, warm 0-recompile) =="
+python -m pytest "tests/test_parallel.py::TestCollectiveAccounting" \
+  "tests/test_parallel.py::TestSpmdSolverEquivalence" -x -q
+
+echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check; incl. the sharded tier vs BENCH_SHARDED_8dev_virtual.json) =="
 python scripts/bench_gate.py
 
 if [[ "${1:-}" == "--slow" ]]; then

@@ -279,8 +279,9 @@ class TestSourceCapping:
         sub = move_dst_matrix(state, ctx, snap, cand, valid, prior, dst_brokers=cols)
         np.testing.assert_array_equal(np.asarray(sub), np.asarray(full)[:, np.asarray(cols)])
 
-        occ_full = _partition_occupancy(state, cand, valid)
-        occ_sub = _partition_occupancy(state, cand, valid, dst_brokers=cols)
+        cand_part = state.replica_partition[cand]
+        occ_full = _partition_occupancy(state, snap, cand_part, valid)
+        occ_sub = _partition_occupancy(state, snap, cand_part, valid, dst_brokers=cols)
         np.testing.assert_array_equal(
             np.asarray(occ_sub), np.asarray(occ_full)[:, np.asarray(cols)]
         )

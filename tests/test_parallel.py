@@ -143,3 +143,229 @@ class TestShardedSolver:
         assert r_shard.spec[0] == "replicas"
         b_shard = sharded.broker_capacity.sharding
         assert all(s is None for s in b_shard.spec) or b_shard.spec == ()
+
+
+# -- ISSUE 14: the O(1)-collective shard_map solver path ----------------------------
+
+
+class TestSpmdSolverEquivalence:
+    """The shard_map fast path is semantics-free: placements, proposals and
+    violations equal the single-device solver bit-for-bit — including shapes
+    whose replica count does NOT divide the mesh (the shard-padding edge)."""
+
+    def _cluster(self, partitions=509, rf=3, brokers=12):
+        # 509 × 3 = 1527 replicas: NOT a multiple of 8 — exercises pad_replicas
+        spec = SyntheticSpec(
+            num_racks=4, num_brokers=brokers, num_topics=6,
+            num_partitions=partitions, replication_factor=rf,
+            distribution="exponential", skew_brokers=3, seed=23,
+            mean_disk=0.2, mean_nw_in=0.15,
+        )
+        return generate(spec)
+
+    def _goals(self):
+        from cruise_control_tpu.analyzer import goals_base as G
+
+        return (G.RACK_AWARE, G.REPLICA_CAPACITY, G.DISK_CAPACITY,
+                G.REPLICA_DISTRIBUTION)
+
+    def test_uneven_replica_count_bit_identical(self, mesh):
+        from cruise_control_tpu.analyzer import goals_base as G
+
+        state, maps = self._cluster()
+        assert (state.num_replicas % N_DEV) != 0, "fixture must hit the pad edge"
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        goals = self._goals()
+        kw = dict(goal_ids=goals,
+                  hard_ids=tuple(g for g in goals if g in G.HARD_GOALS),
+                  enable_heavy_goals=False)
+        sf, sres = GoalOptimizer(**kw).optimize(state, ctx, maps=maps)
+        sh = ShardedGoalOptimizer(mesh=mesh, **kw)
+        assert sh.use_spmd
+        shf, shres = sh.optimize(state, ctx, maps=maps)
+        np.testing.assert_array_equal(
+            np.asarray(sf.replica_broker),
+            np.asarray(shf.replica_broker)[: state.num_replicas],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sf.partition_leader), np.asarray(shf.partition_leader)
+        )
+        assert [
+            (p.tp, p.old_replicas, p.new_replicas) for p in sres.proposals
+        ] == [(p.tp, p.old_replicas, p.new_replicas) for p in shres.proposals]
+        assert sres.violations_after == shres.violations_after
+        assert sres.balancedness_score == shres.balancedness_score
+
+    def test_gspmd_fallback_for_unsupported_goals(self, mesh, monkeypatch):
+        """Goal lists with PreferredLeaderElectionGoal route to the legacy
+        GSPMD path (use_spmd False) and still match single-device."""
+        from cruise_control_tpu.analyzer import goals_base as G
+
+        state, _ = self._cluster(partitions=128, brokers=8)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        goals = (G.RACK_AWARE, G.REPLICA_CAPACITY, G.PREFERRED_LEADER_ELECTION)
+        kw = dict(goal_ids=goals,
+                  hard_ids=(G.RACK_AWARE, G.REPLICA_CAPACITY),
+                  enable_heavy_goals=False)
+        sh = ShardedGoalOptimizer(mesh=mesh, **kw)
+        assert not sh.use_spmd
+        _, sres = GoalOptimizer(**kw).optimize(state, ctx)
+        _, shres = sh.optimize(state, ctx)
+        assert sres.total_moves == shres.total_moves
+        assert sres.violations_after == shres.violations_after
+
+    def test_spmd_env_kill_switch(self, mesh, monkeypatch):
+        monkeypatch.setenv("CC_TPU_SHARDED_SPMD", "0")
+        sh = ShardedGoalOptimizer(mesh=mesh, enable_heavy_goals=False)
+        assert not sh.use_spmd
+
+
+class TestShardedSwapApply:
+    """Regression: a kept swap whose endpoint is owned by a LOWER-index shard
+    produces a NEGATIVE local scatter index after the offset shift — under
+    ``mode="drop"`` a negative index WRAPS (only >= n drops), so the unguarded
+    apply corrupted an unrelated local replica's broker/disk on every shard
+    above the owner.  The sharded apply must equal the single-device
+    ``swap_replicas`` bit-for-bit for cross-shard endpoint pairs."""
+
+    def test_cross_shard_swap_matches_single_device(self, mesh):
+        from functools import partial
+
+        from cruise_control_tpu.analyzer.moves import (
+            KIND_SWAP,
+            MoveBatch,
+            apply_moves,
+        )
+        from cruise_control_tpu.model import arrays as A
+        from cruise_control_tpu.parallel.mesh import REPLICA_AXIS, replicate
+        from cruise_control_tpu.parallel.solver import _state_specs
+        from cruise_control_tpu.parallel.spmd import ReplicaRows, SpmdInfo
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        spec = SyntheticSpec(
+            num_racks=2, num_brokers=8, num_topics=2, num_partitions=32,
+            replication_factor=2, distribution="uniform", skew_brokers=0,
+            seed=41,
+        )
+        state, _ = generate(spec)
+        assert state.num_replicas % N_DEV == 0
+        # endpoints on the FIRST and LAST shard: every shard in between (and
+        # the last one, for the first id) sees a negative local index
+        a = jnp.int32(3)
+        b = jnp.int32(state.num_replicas - 2)
+        rows = ReplicaRows(
+            partition=state.replica_partition[jnp.stack([a, b])],
+            broker=state.replica_broker[jnp.stack([a, b])],
+            disk=state.replica_disk[jnp.stack([a, b])],
+            valid=jnp.ones(2, bool),
+            is_leader=jnp.zeros(2, bool),
+            base_load=state.base_load[jnp.stack([a, b])],
+            eff_load=state.base_load[jnp.stack([a, b])],
+        )
+        moves = MoveBatch(
+            kind=jnp.asarray(KIND_SWAP, jnp.int32),
+            replica=jnp.stack([a]),
+            dst_broker=state.replica_broker[jnp.stack([b])],
+            dst_replica=jnp.stack([b]),
+            score=jnp.ones(1, jnp.float32),
+            rows=rows,
+            view_replica=jnp.zeros(1, jnp.int32),
+            view_dst_replica=jnp.ones(1, jnp.int32),
+        )
+        keep = jnp.ones(1, bool)
+
+        want = A.swap_replicas(state, jnp.stack([a]), jnp.stack([b]))
+
+        sstate = shard_state(state, mesh)
+        spmd = SpmdInfo(
+            axis=REPLICA_AXIS, n=N_DEV, global_R=sstate.num_replicas
+        )
+        sspec = _state_specs(sstate)
+        out = shard_map(
+            partial(apply_moves, spmd=spmd),
+            mesh=mesh,
+            in_specs=(sspec, P(), P()),
+            out_specs=sspec,
+            check_rep=False,
+        )(sstate, replicate(moves, mesh), replicate(keep, mesh))
+        np.testing.assert_array_equal(
+            np.asarray(out.replica_broker), np.asarray(want.replica_broker)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out.replica_disk), np.asarray(want.replica_disk)
+        )
+
+
+class TestCollectiveAccounting:
+    """ISSUE 14 satellite: the 120-all-reduce GSPMD regression can't silently
+    return — the sharded goal step's LOGICAL program must stay at a
+    single-digit collective count, and a warm sharded solve must issue zero
+    XLA recompiles."""
+
+    #: the committed design budget: before/after snapshots (2×(psum+pmin)=4),
+    #: per-round snapshot (psum+pmin=2), candidate-merge + destination-colmax
+    #: all_gathers (2) and the occupancy/row-fetch psum (1) — 9 for the
+    #: RackAware step (its violation sum rides the snapshot psum)
+    MAX_COLLECTIVES = 9
+
+    def _sharded(self, mesh):
+        from cruise_control_tpu.parallel.mesh import REPLICA_AXIS, replicate
+        from cruise_control_tpu.parallel.solver import sharded_steps
+        from cruise_control_tpu.parallel.spmd import SpmdInfo
+
+        spec = SyntheticSpec(
+            num_racks=4, num_brokers=8, num_topics=4, num_partitions=256,
+            replication_factor=3, distribution="exponential", skew_brokers=2,
+            seed=29, mean_disk=0.2, mean_nw_in=0.15,
+        )
+        state, _ = generate(spec)
+        ctx = GoalContext.build(state.num_topics, state.num_brokers)
+        sstate = shard_state(state, mesh)
+        sctx = replicate(ctx, mesh)
+        spmd = SpmdInfo(
+            axis=REPLICA_AXIS, n=N_DEV, global_R=sstate.num_replicas
+        )
+        return state, ctx, sstate, sctx, sharded_steps(mesh, spmd)
+
+    def test_goal_step_logical_collectives_single_digit(self, mesh):
+        import re
+
+        from cruise_control_tpu.analyzer import goals_base as G
+        from cruise_control_tpu.analyzer.goal_rounds import GOAL_ROUNDS
+        from cruise_control_tpu.parallel.spmd import LOGICAL_COLLECTIVE_RE
+
+        _, _, sstate, sctx, steps = self._sharded(mesh)
+        lowered = steps["goal_step"].lower(
+            sstate, sctx,
+            gid=G.RACK_AWARE, round_fns=GOAL_ROUNDS[G.RACK_AWARE],
+            max_rounds=2000, enable_heavy=False,
+            prior_ids=(), admit_ids=(G.RACK_AWARE,),
+        )
+        n = len(re.findall(LOGICAL_COLLECTIVE_RE, lowered.as_text()))
+        assert 0 < n <= self.MAX_COLLECTIVES, (
+            f"sharded goal step lowered with {n} collectives "
+            f"(budget {self.MAX_COLLECTIVES}) — the per-reduction-site "
+            "collective regression is back"
+        )
+
+    def test_warm_sharded_solve_zero_recompiles(self, mesh):
+        from cruise_control_tpu.analyzer import goals_base as G
+        from cruise_control_tpu.obs.recorder import RECORDER
+
+        state, ctx, _, _, _ = self._sharded(mesh)
+        goals = (G.RACK_AWARE, G.REPLICA_CAPACITY, G.DISK_CAPACITY)
+        sh = ShardedGoalOptimizer(
+            mesh=mesh, goal_ids=goals,
+            hard_ids=tuple(g for g in goals if g in G.HARD_GOALS),
+            enable_heavy_goals=False,
+        )
+        sh.optimize(state, ctx)          # compile
+        _, warm = sh.optimize(state, ctx)
+        trace = next(iter(RECORDER.recent(1, kind="optimize")), None)
+        assert trace is not None
+        assert len(trace.compile_events) == 0, (
+            f"warm sharded solve recompiled: {trace.compile_events}"
+        )
+        # dispatch budget unchanged vs the fused single-device layout
+        assert warm.num_dispatches == len(goals) + 4
