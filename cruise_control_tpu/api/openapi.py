@@ -117,6 +117,12 @@ _ENDPOINT_PARAMS = {
         {"name": "broker_number", "in": "query", "required": False,
          "schema": {"type": "integer"},
          "description": "cap on extra brokers the capacity sweep may probe"},
+        {"name": "trace", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": ("JSON LoadTrace spec (traces.trace wire format): "
+                         "adds a planning horizon — the trace evaluated at "
+                         "the current broker count, with peak min-brokers-"
+                         "needed over the horizon in the response")},
     ],
     "HEALTHZ": [
         {"name": "readiness", "in": "query", "required": False,
@@ -143,18 +149,37 @@ _ENDPOINT_PARAMS = {
         {"name": "kind", "in": "query", "required": False,
          "schema": {"type": "string"},
          "description": ("trace kind filter: optimize | execution | detector "
-                         "| model | simulate | user_task | retry | "
-                         "admission | ...")},
+                         "| model | simulate | rollout | replay | user_task "
+                         "| retry | admission | ..."),
+         "methods": ["get"]},
         {"name": "trace_id", "in": "query", "required": False,
          "schema": {"type": "string"},
-         "description": "exact trace id"},
+         "description": "exact trace id",
+         "methods": ["get"]},
         {"name": "parent_id", "in": "query", "required": False,
          "schema": {"type": "string"},
          "description": ("request correlation id (X-Request-Id): returns the "
-                         "user task, optimize and execution traces it caused")},
+                         "user task, optimize and execution traces it caused"),
+         "methods": ["get"]},
         {"name": "limit", "in": "query", "required": False,
          "schema": {"type": "integer"},
-         "description": "newest-first record cap (default 50)"},
+         "description": "newest-first record cap (default 50)",
+         "methods": ["get"]},
+        {"name": "traces", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": ("JSON list of LoadTrace specs (traces.trace wire "
+                         "format: num_steps, step_s, base_factor, seed, "
+                         "segments) — the time axis of the rollout"),
+         "methods": ["post"]},
+        {"name": "policies", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": ("JSON list of AutoscalePolicy specs (traces.policy "
+                         "wire format: scale_out_threshold, "
+                         "scale_in_threshold, min_balancedness, "
+                         "cooldown_ticks, step_brokers, min/max/"
+                         "initial_brokers) — evaluated against every trace "
+                         "in one batched dispatch"),
+         "methods": ["post"]},
     ],
 }
 
@@ -209,26 +234,31 @@ def generate_openapi() -> Dict[str, Any]:
         # pause/resume/tick) — emit one operation per registered method
         methods = [m for m, reg in (("get", GET_ENDPOINTS), ("post", POST_ENDPOINTS))
                    if name in reg]
-        body_schema = RESPONSE_SCHEMAS.get(name)
-        if name in _TEXT_ENDPOINTS:
-            content = {
-                "text/plain": {
-                    "schema": {
-                        "type": "string",
-                        "description": _TEXT_ENDPOINTS[name],
-                    }
-                }
-            }
-        else:
-            content = {
-                "application/json": {
-                    "schema": _schema_to_openapi(body_schema)
-                    if body_schema is not None
-                    else {"type": "object"}
-                }
-            }
         ops: Dict[str, Any] = {}
         for method in methods:
+            # method-qualified schema ("POST TRACES") wins over the bare
+            # endpoint name — dual-method endpoints may answer different
+            # bodies per method
+            body_schema = RESPONSE_SCHEMAS.get(
+                f"{method.upper()} {name}", RESPONSE_SCHEMAS.get(name)
+            )
+            if name in _TEXT_ENDPOINTS:
+                content = {
+                    "text/plain": {
+                        "schema": {
+                            "type": "string",
+                            "description": _TEXT_ENDPOINTS[name],
+                        }
+                    }
+                }
+            else:
+                content = {
+                    "application/json": {
+                        "schema": _schema_to_openapi(body_schema)
+                        if body_schema is not None
+                        else {"type": "object"}
+                    }
+                }
             responses: Dict[str, Any] = {
                 "200": {"description": "success", "content": content}
             }

@@ -253,6 +253,18 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
             "probes": [dict],
             "recommendation": dict,
         },
+        #: planning horizon (trace= param): the trace evaluated at the
+        #: current broker count, peak min-brokers-needed over the horizon
+        "?horizon": {
+            "horizonSteps": int,
+            "stepS": float,
+            "currentBrokers": int,
+            "peakBrokersNeeded": int,
+            "peakStep": int,
+            "brokersToAdd": int,
+            "violationSteps": int,
+            "numDispatches": int,
+        },
     },
     "USER_TASKS": {"userTasks": [_USER_TASK]},
     "REVIEW_BOARD": {"requestInfo": [dict]},
@@ -289,6 +301,39 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
             "by_kind": dict,
             "jsonl_path": (str, None),
         },
+    },
+    #: POST TRACES (batched autoscaling rollouts) answers a different body
+    #: than the GET (flight-recorder read) — method-qualified keys win over
+    #: the bare endpoint name in validate_endpoint / the OpenAPI generator
+    "POST TRACES": {
+        "rollout": {
+            "numPairs": int,
+            "numSteps": int,
+            "bucketBrokers": int,
+            "numDispatches": int,
+            "bucketHit": bool,
+            "durationS": float,
+        },
+        #: per trace: the violation-free policy with the fewest broker-hours
+        "winners": dict,
+        "verdicts": [
+            {
+                "trace": str,
+                "policy": str,
+                "steps": int,
+                "violation_steps": int,
+                "violation_free": bool,
+                "broker_hours": float,
+                "scale_ups": int,
+                "scale_downs": int,
+                "max_drawdown": int,
+                "peak_brokers": int,
+                "final_brokers": int,
+                "min_balancedness": float,
+                "brokers_by_step": [int],
+                "needed_by_step": [int],
+            }
+        ],
     },
 }
 

@@ -73,13 +73,14 @@ POST_ENDPOINTS = {
     "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
     "FIX_OFFLINE_REPLICAS", "STOP_PROPOSAL_EXECUTION", "PAUSE_SAMPLING",
     "RESUME_SAMPLING", "TOPIC_CONFIGURATION", "RIGHTSIZE", "REMOVE_DISKS",
-    "ADMIN", "REVIEW", "SIMULATE", "CONTROLLER",
+    "ADMIN", "REVIEW", "SIMULATE", "CONTROLLER", "TRACES",
 }
 #: POSTs that change cluster state and thus go through two-step verification
-#: (SIMULATE is a pure what-if evaluation — nothing to review; CONTROLLER
-#: pause/resume flips the control loop, never the cluster — parking it in
-#: the purgatory would leave the loop unpausable during an incident)
-REVIEWABLE = POST_ENDPOINTS - {"REVIEW", "SIMULATE", "CONTROLLER"}
+#: (SIMULATE and TRACES are pure what-if evaluations — nothing to review;
+#: CONTROLLER pause/resume flips the control loop, never the cluster —
+#: parking it in the purgatory would leave the loop unpausable during an
+#: incident)
+REVIEWABLE = POST_ENDPOINTS - {"REVIEW", "SIMULATE", "CONTROLLER", "TRACES"}
 #: optimize-family endpoints: anything that would build a cluster model and
 #: run the solver is refused with 503 + Retry-After until the process is
 #: ready (journal recovery finished, monitor windows warm) — the k8s-probe
@@ -88,7 +89,7 @@ REVIEWABLE = POST_ENDPOINTS - {"REVIEW", "SIMULATE", "CONTROLLER"}
 READINESS_GATED = {
     "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
     "FIX_OFFLINE_REPLICAS", "TOPIC_CONFIGURATION", "RIGHTSIZE",
-    "REMOVE_DISKS", "SIMULATE", "PROPOSALS",
+    "REMOVE_DISKS", "SIMULATE", "PROPOSALS", "TRACES",
 }
 #: REBALANCE-family endpoints that, with the backend circuit breaker OPEN,
 #: degrade to the journaled standing proposal set (marked ``degraded=true``)
@@ -765,14 +766,55 @@ class CruiseControlApp:
             "SIMULATE", params, work, to_json=lambda r: r.to_dict()
         )
 
+    def post_traces(self, params):
+        """POST TRACES: batched autoscaling-policy rollouts (traces/ — no
+        reference analogue).
+
+        ``traces`` carries a JSON list of :class:`~cruise_control_tpu.traces
+        .trace.LoadTrace` specs and ``policies`` a JSON list of
+        :class:`~cruise_control_tpu.traces.policy.AutoscalePolicy` specs; the
+        (trace × policy) cross product is scanned through time in one
+        compiled dispatch, returning per-pair SLO-violation steps,
+        broker-hours, scale actions, drawdown — and per-trace winners."""
+        from cruise_control_tpu.traces.policy import policies_from_wire
+        from cruise_control_tpu.traces.trace import traces_from_wire
+
+        goal_ids = _goal_ids(params)
+        raw_traces = params.get("traces", [None])[0]
+        if not raw_traces:
+            raise ValueError("POST TRACES requires a traces JSON list")
+        traces = traces_from_wire(json.loads(raw_traces))
+        raw_pols = params.get("policies", [None])[0]
+        if not raw_pols:
+            raise ValueError("POST TRACES requires a policies JSON list")
+        policies = policies_from_wire(json.loads(raw_pols))
+
+        def work(progress):
+            progress.add_step("WaitingForClusterModel")
+            progress.add_step("TraceRollout")
+            return self.cc.trace_rollout(traces, policies, goal_ids=goal_ids)
+
+        return self._async_op(
+            "TRACES", params, work, to_json=lambda r: r.to_dict()
+        )
+
     def post_rightsize(self, params):
         """RIGHTSIZE: run the batched capacity planner and hand its
         sweep-backed recommendation to the provisioner — the verdict carries
-        measured numbers (sim/planner.py), not the reference's placeholder."""
+        measured numbers (sim/planner.py), not the reference's placeholder.
+        A ``trace`` JSON spec adds a planning horizon: the trace evaluated at
+        the current broker count, with peak min-brokers-needed over the
+        horizon (capacity pre-positioned before the predicted peak)."""
         if self.provisioner is None:
             return 400, {"error": "no provisioner configured"}, {}
         load_factor = float(params.get("load_factor", ["1.0"])[0])
         extra = params.get("broker_number", [None])[0]
+        raw_trace = params.get("trace", [None])[0]
+        horizon_trace = None
+        if raw_trace:
+            from cruise_control_tpu.traces.trace import LoadTrace
+
+            horizon_trace = LoadTrace.from_dict(json.loads(raw_trace))
 
         def work(progress):
             progress.add_step("CapacitySweep")
@@ -781,11 +823,15 @@ class CruiseControlApp:
                 max_extra_brokers=int(extra) if extra else None,
             )
             result = self.provisioner.rightsize(plan.recommendation)
-            return {
+            out = {
                 "state": result.state.value,
                 "summary": result.summary,
                 "plan": plan.to_dict(),
             }
+            if horizon_trace is not None:
+                progress.add_step("TraceHorizon")
+                out["horizon"] = self.cc.trace_horizon(horizon_trace)
+            return out
 
         return self._async_op("RIGHTSIZE", params, work, to_json=lambda r: r)
 
@@ -984,7 +1030,14 @@ class CruiseControlApp:
                     {"error": str(e), "reason": e.reason},
                     self._retry_after_header(e.retry_after_s),
                 )
-        if endpoint in READINESS_GATED and not self.readiness.is_ready:
+        # TRACES is gated only as a POST (the rollout solves against the
+        # cluster model); the GET reads the flight recorder, which must stay
+        # reachable while the process is still warming up
+        if (
+            endpoint in READINESS_GATED
+            and not (endpoint == "TRACES" and method == "GET")
+            and not self.readiness.is_ready
+        ):
             # optimize-family requests are refused, not queued, until the
             # readiness ladder completes — a solve against a recovering
             # executor or an empty monitor window ring can only mislead

@@ -398,9 +398,13 @@ class UserTaskManager:
             self._by_key.pop(t.request_key, None)
 
     def shutdown(self) -> None:
-        self._pool.shutdown(wait=False)
+        self.kill()
         if self._journal is not None:
             try:
                 self._journal.close()
             except Exception:
                 pass
+
+    def kill(self) -> None:
+        """Stop the worker pool WITHOUT sealing the journal (crash simulation)."""
+        self._pool.shutdown(wait=False)

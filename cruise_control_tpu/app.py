@@ -543,6 +543,7 @@ class CruiseControlTpuApp:
         if self._server is not None:
             self._server.shutdown()
         self.anomaly_manager.shutdown()
+        self.monitor.shutdown()
         # graceful shutdown seals the journals' active segments; an ungraceful
         # drop leaves .open segments, which the next boot seals and replays
         if self.execution_journal is not None:
@@ -551,6 +552,24 @@ class CruiseControlTpuApp:
             except Exception:
                 pass
         self.app.user_tasks.shutdown()
+
+    def kill(self) -> None:
+        """Crash simulation: take down every background thread with NONE of
+        the graceful journal work — no segment sealing, no completion
+        records, ``.open`` segments left exactly as a dead process leaves
+        them.  A crash kills threads too: a test that merely drops a running
+        app leaks its detector/refresher threads into later tests, where
+        their periodic optimizes dispatch (and, after a jit-cache clear,
+        recompile) inside unrelated flight-record windows."""
+        self._stop.set()
+        if self.controller is not None:
+            self.controller.kill()   # loop thread down, journal un-sealed
+        self.app.stop_proposal_refresher()
+        if self._server is not None:
+            self._server.shutdown()
+        self.anomaly_manager.shutdown()
+        self.monitor.shutdown()
+        self.app.user_tasks.kill()   # worker pool down, journal un-sealed
 
     @property
     def port(self) -> int:

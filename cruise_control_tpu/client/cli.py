@@ -56,15 +56,28 @@ def build_parser() -> argparse.ArgumentParser:
                     help="operator note recorded with pause/resume")
 
     tr = sub.add_parser(
-        "traces", help="flight-recorder records, filterable by correlation id"
+        "traces",
+        help="flight-recorder records (GET), or — with --traces-json and "
+             "--policies-json — a batched autoscaling-policy rollout (POST): "
+             "every (trace × policy) pair scanned through time in one "
+             "compiled dispatch",
     )
     tr.add_argument("--kind", default=None,
                     help="optimize | execution | user_task | simulate | "
-                         "admission | ...")
+                         "rollout | replay | admission | ...")
     tr.add_argument("--trace-id", default=None)
     tr.add_argument("--parent-id", default=None,
                     help="X-Request-Id: walks request -> task -> optimize -> execution")
     tr.add_argument("--limit", type=int, default=50)
+    tr.add_argument("--traces-json", default=None,
+                    help="JSON list of LoadTrace specs (segments: diurnal | "
+                         "ramp | spike | topic_growth | topic_spike | noise) "
+                         "— switches to the rollout POST")
+    tr.add_argument("--policies-json", default=None,
+                    help="JSON list of AutoscalePolicy specs "
+                         "(scale_out_threshold, scale_in_threshold, "
+                         "cooldown_ticks, step_brokers, min/max_brokers)")
+    tr.add_argument("--goals", default=None, help="comma-separated goal names")
 
     pl = sub.add_parser("partition_load")
     pl.add_argument("--resource", default="DISK")
@@ -87,6 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
         if name == "rightsize":
             p.add_argument("--load-factor", type=float, default=None,
                            help="plan capacity for current load × this factor")
+            p.add_argument("--trace-json", default=None,
+                           help="JSON LoadTrace spec: adds the planning "
+                                "horizon (peak min-brokers-needed over the "
+                                "trace at the current broker count)")
 
     for name in ("add_broker", "remove_broker", "demote_broker"):
         p = sub.add_parser(name)
@@ -162,8 +179,20 @@ def main(argv=None) -> int:
             else:
                 out = client.controller_tick()
         elif ep == "traces":
-            out = client.traces(kind=args.kind, trace_id=args.trace_id,
-                                parent_id=args.parent_id, limit=args.limit)
+            if args.traces_json or args.policies_json:
+                if not (args.traces_json and args.policies_json):
+                    raise SystemExit(
+                        "rollout needs BOTH --traces-json and --policies-json"
+                    )
+                out = client.trace_rollout(
+                    traces=json.loads(args.traces_json),
+                    policies=json.loads(args.policies_json),
+                    goals=args.goals.split(",") if args.goals else None,
+                    wait=wait,
+                )
+            else:
+                out = client.traces(kind=args.kind, trace_id=args.trace_id,
+                                    parent_id=args.parent_id, limit=args.limit)
         elif ep == "partition_load":
             out = client.partition_load(resource=args.resource, entries=args.entries)
         elif ep == "rebalance":
@@ -177,7 +206,11 @@ def main(argv=None) -> int:
         elif ep == "fix_offline_replicas":
             out = client.fix_offline_replicas(dryrun=args.dryrun, wait=wait)
         elif ep == "rightsize":
-            out = client.rightsize(dryrun=args.dryrun, load_factor=args.load_factor, wait=wait)
+            out = client.rightsize(
+                dryrun=args.dryrun, load_factor=args.load_factor,
+                trace=json.loads(args.trace_json) if args.trace_json else None,
+                wait=wait,
+            )
         elif ep == "simulate":
             out = client.simulate(
                 scenarios=json.loads(args.scenarios_json) if args.scenarios_json else None,

@@ -289,11 +289,16 @@ class CruiseControlClient:
         self,
         dryrun: bool = True,
         load_factor: Optional[float] = None,
+        trace: Optional[Dict[str, Any]] = None,
         wait: bool = True,
     ) -> Any:
+        """POST /rightsize; ``trace`` (a LoadTrace dict) adds the planning
+        horizon — peak min-brokers-needed over the trace at the current
+        broker count."""
         return self._post(
             "rightsize", wait=wait, dryrun=str(dryrun).lower(),
             load_factor=load_factor,
+            trace=json.dumps(trace) if trace is not None else None,
         )
 
     def simulate(
@@ -322,6 +327,25 @@ class CruiseControlClient:
             kill_brokerid=self._csv(kill_brokers),
             drop_rack=drop_rack,
             deep=str(deep).lower(),
+            goals=self._csv(goals),
+        )
+
+    def trace_rollout(
+        self,
+        traces: Sequence[Dict[str, Any]],
+        policies: Sequence[Dict[str, Any]],
+        goals: Optional[Sequence[str]] = None,
+        wait: bool = True,
+    ) -> Any:
+        """POST /traces: batched autoscaling-policy rollouts (traces/
+        subsystem).  ``traces`` is a list of LoadTrace dicts and ``policies``
+        a list of AutoscalePolicy dicts (both wire formats); every
+        (trace × policy) pair is scanned through time in one compiled
+        dispatch, returning per-pair verdicts and per-trace winners."""
+        return self._post(
+            "traces", wait=wait,
+            traces=json.dumps(list(traces)),
+            policies=json.dumps(list(policies)),
             goals=self._csv(goals),
         )
 

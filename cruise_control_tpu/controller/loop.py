@@ -88,10 +88,15 @@ class ContinuousController:
         journal: Optional[ControllerJournal] = None,
         config: Optional[ControllerConfig] = None,
         breaker=None,
+        clock=None,
     ) -> None:
         self.cc = cruise_control
         self.journal = journal
         self.cfg = config or ControllerConfig()
+        #: monotonic time source; injectable so the replay harness
+        #: (traces/replay.py) can drive staleness, cadence and reaction
+        #: latency on a fake clock without sleeping
+        self._clock = clock if clock is not None else time.monotonic
         #: shared backend circuit breaker: while open the loop holds position
         #: — no ticks, no rebuilds, standing set stays published (the
         #: degraded REBALANCE answers are served from it)
@@ -140,7 +145,7 @@ class ContinuousController:
         self._last_delta: Optional[WindowDelta] = None
         self._last_delta_mono: Optional[float] = None
         self._shift_t0: Optional[float] = None
-        self._started_mono = time.monotonic()
+        self._started_mono = self._clock()
         self._last_topology_probe = 0.0
         self._last_tick_attrs: Optional[dict] = None
 
@@ -175,16 +180,20 @@ class ContinuousController:
         self._thread.start()
 
     def stop(self) -> None:
-        self._stop.set()
-        self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-            self._thread = None
+        self.kill()
         if self.journal is not None:
             try:
                 self.journal.close()
             except Exception:
                 pass
+
+    def kill(self) -> None:
+        """Stop the loop thread WITHOUT sealing the journal (crash simulation)."""
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
 
     def _loop(self) -> None:
         from cruise_control_tpu.core.sensors import (
@@ -323,7 +332,7 @@ class ContinuousController:
         the ingest's unknown-tp signal; this probe only exists for the
         replica-less new/removed broker case, which one cadence interval of
         lag cannot hurt."""
-        now = time.monotonic()
+        now = self._clock()
         if now - self._last_topology_probe < self.cfg.tick_interval_s:
             return False
         self._last_topology_probe = now
@@ -524,7 +533,7 @@ class ContinuousController:
             )
         )
 
-        now = time.monotonic()
+        now = self._clock()
         cadence_due = (now - self._last_solve_mono) >= self.cfg.tick_interval_s
         stale = self._staleness_s() > self.cfg.stale_after_s
         if force:
@@ -613,7 +622,7 @@ class ContinuousController:
         publish_error: Optional[str] = None
         if proposals:
             if anchor is not None:
-                reaction_s = time.monotonic() - anchor
+                reaction_s = self._clock() - anchor
             candidate = StandingProposalSet(
                 version=self._version + 1,
                 created_ms=int(time.time() * 1000),
@@ -676,7 +685,7 @@ class ContinuousController:
             if published is not None:
                 self._candidate_state = final
             self._solved_viol = inc.violations_after
-            self._last_solve_mono = time.monotonic()
+            self._last_solve_mono = self._clock()
 
         # -- optional drain through the executor (existing policy knobs) ------
         drained = False
@@ -741,7 +750,7 @@ class ContinuousController:
         anchor = self._last_delta_mono
         if anchor is None:
             anchor = self._started_mono
-        return max(time.monotonic() - anchor, 0.0)
+        return max(self._clock() - anchor, 0.0)
 
     def _update_staleness_gauge(self) -> None:
         from cruise_control_tpu.core.sensors import (

@@ -250,3 +250,12 @@ BREAKER_PROBES_COUNTER = "CircuitBreaker.probes"
 BREAKER_FAST_FAILURES_COUNTER = "CircuitBreaker.fast-failures"
 BREAKER_STATE_GAUGE = "CircuitBreaker.state"      # 0 closed, 1 half-open, 2 open
 DETECTOR_BREAKER_SKIPS_COUNTER = "AnomalyDetector.passes-skipped-breaker-open"
+# window-listener failures (monitor/loadmonitor.py _notify_windows) — a
+# listener raising must never break ingest, but it must not vanish either
+MONITOR_LISTENER_ERRORS_COUNTER = "LoadMonitor.listener-errors"
+# time-series scenario engine (traces/)
+TRACE_ROLLOUTS_COUNTER = "TraceEngine.rollouts"
+TRACE_PAIRS_COUNTER = "TraceEngine.pairs-evaluated"
+TRACE_ROLLOUT_TIMER = "TraceEngine.rollout-timer"
+TRACE_REPLAYS_COUNTER = "TraceEngine.replays"
+TRACE_REPLAY_STEPS_COUNTER = "TraceEngine.replay-steps"

@@ -343,6 +343,51 @@ class CruiseControl:
             return sim_batch.deep_sweep(state, scenarios, **kw)
         return sim_batch.fast_sweep(state, scenarios, mesh=mesh, **kw)
 
+    def trace_rollout(
+        self,
+        traces: Sequence["LoadTrace"],
+        policies: Sequence["AutoscalePolicy"],
+        goal_ids: Optional[Sequence[int]] = None,
+    ) -> "RolloutResult":
+        """Batched (trace × policy) autoscaling rollouts (the POST /TRACES
+        endpoint substrate): every pair scanned through time in ONE compiled
+        dispatch (``traces.rollout.rollout``), with per-pair SLO-violation
+        steps, broker-hours, scale actions and drawdown verdicts."""
+        from cruise_control_tpu.traces.rollout import rollout as _rollout
+
+        model = self.cluster_model()
+        state, _ = model.to_arrays()
+        gids = tuple(goal_ids) if goal_ids is not None else self.goal_ids
+        return _rollout(
+            state,
+            traces,
+            policies,
+            constraint=self.constraint,
+            goal_ids=gids,
+            hard_ids=tuple(g for g in self.hard_ids if g in gids) or self.hard_ids,
+        )
+
+    def trace_horizon(
+        self,
+        trace: "LoadTrace",
+        goal_ids: Optional[Sequence[int]] = None,
+    ) -> dict:
+        """RIGHTSIZE planning horizon: the trace evaluated at the current
+        broker count, reporting peak min-brokers-needed over the horizon —
+        pre-position capacity before the predicted peak, not after it."""
+        from cruise_control_tpu.traces.rollout import horizon_requirements
+
+        model = self.cluster_model()
+        state, _ = model.to_arrays()
+        gids = tuple(goal_ids) if goal_ids is not None else self.goal_ids
+        return horizon_requirements(
+            state,
+            trace,
+            constraint=self.constraint,
+            goal_ids=gids,
+            hard_ids=tuple(g for g in self.hard_ids if g in gids) or self.hard_ids,
+        )
+
     def plan_capacity(
         self,
         load_factor: float = 1.0,

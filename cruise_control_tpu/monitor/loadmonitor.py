@@ -18,6 +18,7 @@ Counterpart of ``monitor/LoadMonitor.java:78`` and its task runner
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -50,6 +51,8 @@ from cruise_control_tpu.monitor.samples import MetricSampler, SampleBatch
 from cruise_control_tpu.monitor.samplestore import NoopSampleStore, SampleStore
 
 _P_IDX = {info.name: info.id for info in COMMON_METRIC_DEF.all()}
+
+LOG = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,10 +109,15 @@ class LoadMonitor:
         min_samples_per_window: int = 1,
         sample_store: Optional[SampleStore] = None,
         max_concurrent_model_generations: int = 1,
+        clock=None,
     ) -> None:
         self.backend = backend
         self.sampler = sampler
         self.capacity_resolver = capacity_resolver
+        #: monotonic time source stamped onto WindowDelta.ingest_monotonic —
+        #: injectable so the replay harness shares one fake clock with the
+        #: controller and reaction latency stays deterministic
+        self._clock = clock if clock is not None else time.monotonic
         self.window_ms = window_ms
         self.num_windows = num_windows
         self.sample_store = sample_store or NoopSampleStore()
@@ -276,13 +284,25 @@ class LoadMonitor:
             ts_ms=int(ts),
             num_samples=len(batch),
             new_window=new_window,
-            ingest_monotonic=time.monotonic(),
+            ingest_monotonic=self._clock(),
         )
         for fn in list(self._window_listeners):
             try:
                 fn(delta)
             except Exception:
-                pass
+                # swallowed by design (the sampling loop must survive a
+                # subscriber bug) but never silently: counted + named
+                from cruise_control_tpu.core.sensors import (
+                    MONITOR_LISTENER_ERRORS_COUNTER,
+                    REGISTRY,
+                )
+
+                REGISTRY.counter(MONITOR_LISTENER_ERRORS_COUNTER).inc()
+                LOG.debug(
+                    "window listener %s raised",
+                    getattr(fn, "__qualname__", repr(fn)),
+                    exc_info=True,
+                )
 
     # -- model generation ---------------------------------------------------
 

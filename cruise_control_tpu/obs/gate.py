@@ -318,6 +318,52 @@ def _run_serving_tier(inject_sleep_s: float = 0.0) -> dict:
     }
 
 
+def _run_traces_tier(inject_sleep_s: float = 0.0) -> dict:
+    """Trace-engine tier: batched-rollout warm wall + the one-program budget.
+
+    Runs the SAME harness that commits ``benchmarks/BENCH_TRACES_cpu.json``
+    (``cruise_control_tpu/traces/bench.py``): a 16-pair × 64-step batched
+    autoscaling rollout.  The contract violations — warm dispatches over the
+    budget, ANY attributed XLA compile during the warm rollout, a missed
+    executable-shape bucket — are hard errors; the warm wall is the gated
+    metric (>25 % vs the committed artifact fails, see ``_traces_baseline``).
+    """
+    _force_cpu_platform()
+    from cruise_control_tpu.traces import bench
+
+    m = bench.run_bench()
+    errors = []
+    if m["warm_dispatches"] > m["dispatch_budget"]:
+        errors.append(
+            f"{m['warm_dispatches']} warm dispatches > budget "
+            f"{m['dispatch_budget']}"
+        )
+    if m["warm_compile_events"]:
+        errors.append(
+            f"{m['warm_compile_events']} XLA compile event(s) during the "
+            "warm rollout"
+        )
+    if not m["bucket_hit"]:
+        errors.append("warm rollout missed the executable-shape bucket")
+    if errors:
+        return {"tier": "traces", "error": "; ".join(errors)}
+    wall = m["warm_s"]
+    if inject_sleep_s:
+        time.sleep(inject_sleep_s)
+        wall += inject_sleep_s
+    return {
+        "tier": "traces",
+        "platform": "cpu",
+        "wall_s": round(wall, 4),
+        "cold_s": m["cold_s"],
+        "pairs": m["pairs"],
+        "steps": m["steps"],
+        "warm_dispatches": m["warm_dispatches"],
+        "warm_compile_events": m["warm_compile_events"],
+        "bucket_hit": m["bucket_hit"],
+    }
+
+
 _SHARDED_ARTIFACT = os.path.join("benchmarks", "BENCH_SHARDED_8dev_virtual.json")
 #: the O(1)-collective contract: a sharded goal step's LOGICAL program must
 #: stay single-digit (the GSPMD regression this gate exists to refuse was 120)
@@ -484,6 +530,19 @@ def _serving_baseline(root: str) -> Optional[dict]:
     return {"wall_s": doc.get("p95_admitted_s")}
 
 
+def _traces_baseline(root: str) -> Optional[dict]:
+    """Gate baseline for the traces tier, derived from the committed bench
+    artifact (``benchmarks/BENCH_TRACES_cpu.json``) — same single-source
+    pattern as the controller/serving tiers."""
+    path = os.path.join(root, "benchmarks", "BENCH_TRACES_cpu.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return {"wall_s": doc.get("warm_s")}
+
+
 def _controller_baseline(root: str) -> Optional[dict]:
     """Gate baseline for the controller tier, derived from the committed
     bench artifact (``benchmarks/BENCH_CONTROLLER_cpu.json``) — the ISSUE
@@ -525,11 +584,15 @@ TIERS: Dict[str, GateTier] = {
                  "proposal identity vs BENCH_SHARDED_8dev_virtual.json",
                  build=None, bench_comparable=False, needs_devices=8,
                  runner=_run_sharded_tier),
+        GateTier("traces", "batched rollout warm wall + one-program budget "
+                 "vs BENCH_TRACES_cpu.json",
+                 build=None, bench_comparable=False,
+                 runner=_run_traces_tier),
     )
 }
 DEFAULT_TIERS = (
     "config1", "config2_small", "mesh8", "exporter", "controller", "serving",
-    "sharded",
+    "sharded", "traces",
 )
 
 
@@ -896,6 +959,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"collectives={m.get('collectives_per_goal_step')} "
                 f"warm_compiles={m.get('warm_compile_events')}"
             )
+        elif "bucket_hit" in m:   # traces tier: warm rollout wall + budget
+            status = (
+                f"wall={m['wall_s']}s pairs={m.get('pairs')} "
+                f"dispatches={m.get('warm_dispatches')} "
+                f"warm_compiles={m.get('warm_compile_events')}"
+            )
         elif "goodput_rps" in m:   # serving tier: admitted p95 + shed contract
             status = (
                 f"p95_admitted={m['wall_s']}s admitted={m.get('admitted')} "
@@ -955,6 +1024,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             # same single-source pattern: the serving tier gates against
             # benchmarks/BENCH_SERVING_cpu.json (scripts/bench_serving.py)
             base = _serving_baseline(root)
+        if base is None and m["tier"] == "traces":
+            # and the traces tier against benchmarks/BENCH_TRACES_cpu.json
+            # (scripts/bench_traces.py)
+            base = _traces_baseline(root)
         if base is None:
             failures.append(
                 f"{m['tier']}: no committed gate baseline for this tier "

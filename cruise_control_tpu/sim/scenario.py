@@ -54,6 +54,21 @@ from cruise_control_tpu.model.arrays import (  # noqa: F401  (re-exported API)
 )
 
 
+def check_wire_keys(d: Mapping, allowed: Sequence[str], what: str) -> None:
+    """Reject unknown keys in a wire-format dict.
+
+    A typo'd key (``load_factorr``) silently yielding an unmodified scenario
+    is the worst failure mode a what-if API can have — the caller gets a
+    confident verdict about a question they didn't ask.  Shared by every
+    wire parser in ``sim/`` and ``traces/``."""
+    unknown = sorted(set(d) - set(allowed))
+    if unknown:
+        raise ValueError(
+            f"{what}: unknown key(s) {unknown}; allowed keys are "
+            f"{sorted(allowed)}"
+        )
+
+
 @dataclasses.dataclass(frozen=True)
 class Scenario:
     """One hypothetical edit of the base cluster (all fields optional)."""
@@ -112,8 +127,14 @@ class Scenario:
             d["goal_order"] = [G.GOAL_NAMES[g] for g in self.goal_order]
         return d
 
+    _WIRE_KEYS = (
+        "name", "add_brokers", "remove_brokers", "kill_brokers", "drop_rack",
+        "load_factor", "topic_load_factors", "capacity_factors", "goal_order",
+    )
+
     @classmethod
     def from_dict(cls, d: Mapping) -> "Scenario":
+        check_wire_keys(d, cls._WIRE_KEYS, f"scenario {d.get('name', '')!r}")
         goal_order = None
         if d.get("goal_order"):
             ids = []

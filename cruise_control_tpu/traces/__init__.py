@@ -1,0 +1,52 @@
+"""Time-series scenario engine: load traces, policy rollouts, replay.
+
+Three coupled pieces on top of ``sim/`` (ROADMAP item 4):
+
+* :mod:`cruise_control_tpu.traces.trace` — the declarative :class:`LoadTrace`
+  DSL: seeded-deterministic segment composition (diurnal sinusoid, ramps,
+  spikes, per-topic growth, noise) into per-step load-factor vectors; every
+  trace step *is* a :class:`~cruise_control_tpu.sim.scenario.Scenario`.
+* :mod:`cruise_control_tpu.traces.rollout` — batched
+  :class:`~cruise_control_tpu.traces.policy.AutoscalePolicy` evaluation:
+  ``lax.scan`` over time × ``jax.vmap`` over (trace, policy) pairs on the
+  bucketed satisfiability kernel, ONE compiled dispatch for the whole batch.
+* :mod:`cruise_control_tpu.traces.replay` — drive a trace-synthesized metric
+  stream through the monitor's window-listener seam against a real
+  :class:`~cruise_control_tpu.controller.loop.ContinuousController` on a
+  fake clock (no sleeping), with ``kind="replay"`` flight records.
+"""
+
+from cruise_control_tpu.traces.policy import AutoscalePolicy, frozen_policy
+from cruise_control_tpu.traces.replay import FakeClock, ReplayReport, run_replay
+from cruise_control_tpu.traces.rollout import (
+    RolloutResult,
+    RolloutVerdict,
+    horizon_requirements,
+    rollout,
+)
+from cruise_control_tpu.traces.trace import (
+    LoadTrace,
+    TraceSegment,
+    diurnal_trace,
+    drift_storm_trace,
+    ramp_trace,
+    spike_trace,
+)
+
+__all__ = [
+    "AutoscalePolicy",
+    "FakeClock",
+    "LoadTrace",
+    "ReplayReport",
+    "RolloutResult",
+    "RolloutVerdict",
+    "TraceSegment",
+    "diurnal_trace",
+    "drift_storm_trace",
+    "frozen_policy",
+    "horizon_requirements",
+    "ramp_trace",
+    "rollout",
+    "run_replay",
+    "spike_trace",
+]

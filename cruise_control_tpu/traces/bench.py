@@ -1,0 +1,101 @@
+"""Shared trace-engine bench harness: batched-rollout wall + dispatch budget.
+
+One measurement function serves three consumers — ``scripts/bench_traces.py``
+(the committed ``benchmarks/BENCH_TRACES_cpu.json`` artifact + CI step), the
+``traces`` tier of the regression gate (``obs/gate.py``), and the acceptance
+tests — so the number the gate enforces is measured by exactly the code the
+bench committed (the ``controller``/``serving`` single-source pattern).
+
+The workload: the acceptance-contract shape — 16 (trace × policy) pairs over
+a 64-step trace on a seeded 10-broker synthetic cluster, bucketed to 16
+brokers.  Measured: cold wall (includes the XLA compile), best-of-N warm
+wall, the warm rollout's dispatch count and attributed XLA compile events
+(both from the ``kind="rollout"`` flight record), and the executable-shape
+bucket hit.  The contract: a warm rollout is ≤ 2 dispatches, ZERO compile
+events, and a bucket hit — N pairs cost one program, not N.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+#: pinned workload — changing any of these requires --update-baseline
+PAIRS = 16
+STEPS = 64
+BUCKET = 16
+DISPATCH_BUDGET = 2
+
+LIGHT = dict(mean_cpu=0.08, mean_disk=0.08, mean_nw_in=0.08, mean_nw_out=0.06)
+
+
+def _workload():
+    from cruise_control_tpu.synthetic import SyntheticSpec, generate
+    from cruise_control_tpu.traces.policy import AutoscalePolicy
+    from cruise_control_tpu.traces.trace import (
+        diurnal_trace,
+        ramp_trace,
+        spike_trace,
+    )
+
+    spec = SyntheticSpec(
+        num_racks=5, num_brokers=10, num_topics=5, num_partitions=50,
+        replication_factor=2, seed=2, **LIGHT,
+    )
+    state, _ = generate(spec)
+    traces = [
+        diurnal_trace(name="diurnal", num_steps=STEPS, amplitude=0.4),
+        ramp_trace(name="ramp", num_steps=STEPS, rate=0.02),
+        spike_trace(name="spike", num_steps=STEPS, at=16, magnitude=1.5),
+        diurnal_trace(name="noisy", num_steps=STEPS, amplitude=0.3,
+                      sigma=0.05, seed=9),
+    ]
+    policies = [
+        AutoscalePolicy(
+            name=f"p{i}", scale_out_threshold=0.6 + 0.08 * i,
+            scale_in_threshold=0.3, cooldown_ticks=i,
+            step_brokers=1 + i % 2, max_brokers=BUCKET,
+        )
+        for i in range(4)
+    ]
+    return state, traces, policies
+
+
+def run_bench(warm_repeats: int = 2) -> Dict:
+    """Cold + warm batched rollouts; warm numbers from the flight record."""
+    from cruise_control_tpu.obs.recorder import RECORDER
+    from cruise_control_tpu.traces.rollout import rollout
+
+    state, traces, policies = _workload()
+
+    t0 = time.monotonic()
+    cold = rollout(state, traces, policies, bucket_brokers=BUCKET)
+    cold_s = time.monotonic() - t0
+
+    warm_s = float("inf")
+    warm = cold
+    for _ in range(max(warm_repeats, 1)):
+        t0 = time.monotonic()
+        warm = rollout(state, traces, policies, bucket_brokers=BUCKET)
+        warm_s = min(warm_s, time.monotonic() - t0)
+
+    record = next(iter(RECORDER.recent(1, kind="rollout")), None)
+    warm_dispatches = (
+        int(record.attrs.get("num_dispatches", -1)) if record else -1
+    )
+    warm_compiles = len(record.compile_events) if record else -1
+
+    return {
+        "pairs": warm.num_pairs,
+        "steps": warm.num_steps,
+        "bucket_brokers": BUCKET,
+        "cold_s": round(cold_s, 4),
+        "warm_s": round(warm_s, 4),
+        "warm_dispatches": warm_dispatches,
+        "dispatch_budget": DISPATCH_BUDGET,
+        "warm_compile_events": warm_compiles,
+        "bucket_hit": bool(warm.bucket_hit),
+        "violation_free_pairs": sum(
+            1 for v in warm.verdicts if v.violation_free
+        ),
+    }

@@ -1,0 +1,444 @@
+"""Batched autoscaling-policy rollouts: N (trace × policy) pairs, ONE dispatch.
+
+The rollout answers "which policy holds the hard goals through this trace
+with the fewest broker-hours" by scanning every pair through time on device:
+
+* **Time is a ``lax.scan``.**  The carry is the dense per-broker state of the
+  pair — target broker count + cooldown — and each step rebuilds the stepped
+  cluster *inside* the program from the shared base pytree: the load leaves
+  scale by the step's (global × per-topic) factors exactly as
+  ``apply_scenario`` scales them on the host, and the broker axis is the
+  bucketed full-headroom state masked down to the current count.  A trace
+  step is therefore bit-identical to the scenario ``fast_sweep`` would build
+  for it (tests/test_traces.py asserts this at B=1).
+* **Pairs are a ``jax.vmap``.**  Traces enter as stacked ``[N, T]`` factor
+  arrays, policies as packed dynamic scalars (``policy.pack_policies``); the
+  cluster pytree is closed over unbatched, so N pairs share one copy of the
+  replica/partition arrays and one compiled program per
+  (bucket, T, goal-subset) shape — the ``sim/`` bucket-ladder caching
+  argument applied along the time axis.
+* **The step evaluator is the sweep kernel's.**  Per step:
+  ``take_snapshot`` + ``violations_all`` + ``_hard_satisfiability`` + the
+  offline-movement floor — the exact per-scenario body of
+  ``sim.batch._sweep_kernel_fn`` — then the policy's threshold logic updates
+  the carry (scale out on pressure/unsatisfiability/balancedness-floor,
+  scale in on slack, cooldown-gated, min/max-clamped).
+
+Dispatch accounting mirrors ``fast_sweep``: one jitted computation per
+rollout (the bulk ``device_get`` is not a dispatch); executable-shape
+hits/misses land in the ``ScenarioPlanner.*`` sensors plus ``TraceEngine.*``
+counters, and every rollout emits a ``kind="rollout"`` flight record carrying
+the pair count, trace length, bucket shape and any attributed XLA compiles —
+the ≤-2-dispatches / 0-warm-recompile contract is assertable from the trace
+alone (and gated by ``scripts/bench_traces.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.context import GoalContext, take_snapshot
+from cruise_control_tpu.analyzer.optimizer import (
+    MAX_BALANCEDNESS_SCORE,
+    balancedness_cost_by_goal,
+)
+from cruise_control_tpu.model.arrays import ClusterArrays, broker_bucket
+from cruise_control_tpu.obs.profiler import PROFILER, profile_jit
+from cruise_control_tpu.sim.batch import _hard_satisfiability, _note_shape
+from cruise_control_tpu.sim.scenario import Scenario, apply_scenario
+from cruise_control_tpu.traces.policy import AutoscalePolicy, pack_policies
+from cruise_control_tpu.traces.trace import LoadTrace
+
+
+# -- the kernel ---------------------------------------------------------------
+
+
+def _step_cluster(full: ClusterArrays, base_brokers: int, n, f_t, tf_t):
+    """The stepped cluster for target broker count ``n`` at factors
+    ``(f_t, tf_t)`` — the in-program twin of ``apply_scenario``:
+
+    * slots ``[0, base)`` are the base brokers (scale-in disables the tail,
+      keeping capacity, exactly REMOVE_BROKER semantics);
+    * slots ``[base, n)`` are activated headroom brokers (alive-mean
+      capacity, NEW flag — ADD_BROKER semantics);
+    * slots ``[n, bucket)`` beyond the base are inert padding (zero
+      capacity) — the same state ``apply_scenario(add_brokers=n-base)``
+      materializes on the host.
+    """
+    ar = jnp.arange(full.num_brokers, dtype=jnp.int32)
+    enabled = ar < n
+    alive = full.broker_alive & enabled
+    # base brokers keep their capacity even when disabled (REMOVE semantics);
+    # headroom slots past n are padding and carry none (ADD semantics)
+    cap_on = enabled | (ar < base_brokers)
+    cap = jnp.where(cap_on[:, None], full.broker_capacity, 0.0)
+    new = full.broker_new & enabled
+
+    # load scaling: identical algebra (and identical f32 ops) to
+    # apply_scenario — global factor × per-topic factor on both the
+    # follower-equivalent base and the leadership delta
+    pfac = f_t * tf_t[full.partition_topic]
+    rfac = pfac[full.replica_partition]
+    return full.replace(
+        base_load=full.base_load * rfac[:, None],
+        leadership_delta=full.leadership_delta * pfac[:, None],
+        broker_alive=alive,
+        broker_capacity=cap,
+        broker_new=new,
+    )
+
+
+def _rollout_kernel_fn(
+    full: ClusterArrays,
+    ctx: GoalContext,
+    global_f,      # f32[N, T]
+    topic_f,       # f32[N, T, topics]
+    policy,        # dict of [N] scalars (pack_policies)
+    cost_vec,      # f32[NUM_GOALS] balancedness cost per goal
+    base_brokers: int,
+    subset=None,
+):
+    """scan(time) ∘ vmap(pairs): every per-step series for every pair."""
+
+    def one_pair(gf, tf, out_thr, in_thr, min_bal, cool_t, step_b, min_b,
+                 max_b, init_b):
+        def step(carry, xs):
+            n, cooldown = carry
+            f_t, tf_t = xs
+            state = _step_cluster(full, base_brokers, n, f_t, tf_t)
+
+            snap = take_snapshot(state, ctx, False)
+            viol = G.violations_all(state, ctx, snap, subset=subset)
+            sat, needed = _hard_satisfiability(state, ctx)
+            alive_n = state.broker_alive.sum().astype(jnp.int32)
+            bal = MAX_BALANCEDNESS_SCORE - jnp.where(
+                viol > 0, cost_vec, 0.0
+            ).sum()
+
+            # -- policy: threshold controller over the pressure signal -------
+            a_f = alive_n.astype(jnp.float32)
+            pressure = needed.astype(jnp.float32)
+            want_out = (
+                (~sat)
+                | (pressure > out_thr * a_f)
+                | ((min_bal > 0) & (bal < min_bal))
+            )
+            want_in = (~want_out) & (pressure < in_thr * a_f)
+            delta = jnp.where(
+                want_out, step_b, jnp.where(want_in, -step_b, 0)
+            )
+            delta = jnp.where(cooldown <= 0, delta, 0)
+            n_next = jnp.clip(n + delta, min_b, max_b)
+            acted = n_next != n
+            cooldown_next = jnp.where(
+                acted, cool_t, jnp.maximum(cooldown - 1, 0)
+            )
+            outs = (
+                viol, sat, needed, alive_n, (n_next - n).astype(jnp.int32),
+            )
+            return (n_next, cooldown_next), outs
+
+        init = (init_b, jnp.zeros((), jnp.int32))
+        _, outs = jax.lax.scan(step, init, (gf, tf))
+        return outs
+
+    return jax.vmap(one_pair)(
+        global_f, topic_f,
+        policy["out_thr"], policy["in_thr"], policy["min_bal"],
+        policy["cooldown"], policy["step"], policy["min_b"],
+        policy["max_b"], policy["init_b"],
+    )
+
+
+_rollout_kernel = profile_jit(
+    "traces.rollout_kernel",
+    partial(jax.jit, static_argnames=("base_brokers", "subset"))(
+        _rollout_kernel_fn
+    ),
+)
+
+
+# -- results ------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RolloutVerdict:
+    """One (trace, policy) pair's outcome."""
+
+    trace: str
+    policy: str
+    steps: int
+    #: steps where NO placement of the then-alive brokers could satisfy the
+    #: hard goals (the satisfiability kernel's verdict — placement-independent)
+    violation_steps: int
+    broker_hours: float
+    scale_ups: int
+    scale_downs: int
+    #: worst capacity deficit over the trace: max(min-brokers-needed − alive)
+    max_drawdown: int
+    peak_brokers: int
+    final_brokers: int
+    min_balancedness: float
+    #: per-step series for plotting / the replay seam (trimmed to ``steps``)
+    brokers_by_step: List[int] = dataclasses.field(default_factory=list)
+    needed_by_step: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def violation_free(self) -> bool:
+        return self.violation_steps == 0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["violation_free"] = self.violation_free
+        return d
+
+
+@dataclasses.dataclass
+class RolloutResult:
+    """Outcome of one batched rollout."""
+
+    verdicts: List[RolloutVerdict]
+    num_pairs: int
+    num_steps: int
+    bucket: Tuple[int, int, int]
+    num_dispatches: int
+    bucket_hit: bool
+    duration_s: float
+
+    def winners(self) -> Dict[str, Optional[str]]:
+        """Per trace: the violation-free policy with the fewest broker-hours
+        (None when no policy holds the hard goals through the trace)."""
+        best: Dict[str, RolloutVerdict] = {}
+        for v in self.verdicts:
+            if not v.violation_free:
+                continue
+            cur = best.get(v.trace)
+            if cur is None or v.broker_hours < cur.broker_hours:
+                best[v.trace] = v
+        return {
+            t: (best[t].policy if t in best else None)
+            for t in dict.fromkeys(v.trace for v in self.verdicts)
+        }
+
+    def to_dict(self) -> dict:
+        return {
+            "rollout": {
+                "numPairs": self.num_pairs,
+                "numSteps": self.num_steps,
+                "bucketBrokers": self.bucket[0],
+                "numDispatches": self.num_dispatches,
+                "bucketHit": self.bucket_hit,
+                "durationS": round(self.duration_s, 4),
+            },
+            "winners": self.winners(),
+            "verdicts": [v.to_dict() for v in self.verdicts],
+        }
+
+
+# -- the public rollout -------------------------------------------------------
+
+
+def _full_headroom_state(
+    base: ClusterArrays, bucket_brokers: Optional[int], max_needed: int
+) -> Tuple[ClusterArrays, int]:
+    """The base cluster with EVERY headroom slot activated (ADD semantics up
+    to the bucket) — the shared pytree every pair's step masks down from."""
+    B = base.num_brokers
+    need = max(B, max_needed)
+    B_pad = broker_bucket(need) if bucket_brokers is None else int(bucket_brokers)
+    if B_pad < need:
+        raise ValueError(
+            f"bucket_brokers={B_pad} smaller than the policies' max {need}"
+        )
+    full = apply_scenario(
+        base, Scenario(name="headroom", add_brokers=B_pad - B),
+        bucket_brokers=B_pad,
+    )
+    return full, B_pad
+
+
+def rollout(
+    base: ClusterArrays,
+    traces: Sequence[LoadTrace],
+    policies: Sequence[AutoscalePolicy],
+    constraint: Optional[BalancingConstraint] = None,
+    goal_ids: Sequence[int] = G.DEFAULT_GOAL_ORDER,
+    hard_ids: Sequence[int] = G.HARD_GOALS,
+    bucket_brokers: Optional[int] = None,
+) -> RolloutResult:
+    """Evaluate the (trace × policy) cross product in one compiled dispatch.
+
+    Traces of different lengths share the batch: shorter traces pad their
+    factor arrays with 1.0 and their tail steps are masked out of every
+    aggregate.  The broker bucket covers the largest ``max_brokers`` any
+    policy can reach, so repeated rollouts with different policy bounds share
+    one executable."""
+    from cruise_control_tpu.core.sensors import (
+        REGISTRY,
+        TRACE_PAIRS_COUNTER,
+        TRACE_ROLLOUTS_COUNTER,
+        TRACE_ROLLOUT_TIMER,
+    )
+    from cruise_control_tpu.obs import recorder as obs
+
+    if not traces:
+        raise ValueError("rollout needs at least one trace")
+    if not policies:
+        raise ValueError("rollout needs at least one policy")
+    token = obs.start_trace("rollout")
+    cost_mark = PROFILER.mark()
+    t0 = time.monotonic()
+    goal_ids = tuple(goal_ids)
+    hard_ids = tuple(hard_ids)
+
+    max_policy_b = max(
+        (p.max_brokers or 0) for p in policies
+    )
+    full, B_pad = _full_headroom_state(base, bucket_brokers, max_policy_b)
+    ctx = GoalContext.build(base.num_topics, B_pad, constraint=constraint)
+
+    # materialize every trace once; stack the cross product [N, T]
+    mats = [tr.materialize(base.num_topics) for tr in traces]
+    T = max(m.num_steps for m in mats)
+    topics = max(base.num_topics, 1)
+    pairs = [(ti, pi) for ti in range(len(traces)) for pi in range(len(policies))]
+    N = len(pairs)
+    gf = np.ones((N, T), np.float32)
+    tf = np.ones((N, T, topics), np.float32)
+    valid = np.zeros((N, T), bool)
+    for row, (ti, _) in enumerate(pairs):
+        m = mats[ti]
+        S = m.num_steps
+        gf[row, :S] = m.global_factor
+        tf[row, :S, :] = m.topic_factor
+        valid[row, :S] = True
+    packed = pack_policies(
+        [policies[pi] for _, pi in pairs], base.num_brokers, B_pad
+    )
+
+    costs = balancedness_cost_by_goal(list(goal_ids), set(hard_ids))
+    cost_vec = np.zeros(G.NUM_GOALS, np.float32)
+    for g, c in costs.items():
+        cost_vec[g] = c
+    build_s = time.monotonic() - t0
+
+    key = ("rollout", N, T, B_pad, base.num_replicas, base.num_partitions,
+           goal_ids)
+    hit = _note_shape(key)
+
+    t1 = time.monotonic()
+    viol, sat, needed, alive, action = jax.device_get(
+        _rollout_kernel(
+            full, ctx, gf, tf, packed, cost_vec,
+            base_brokers=base.num_brokers, subset=goal_ids,
+        )
+    )
+    sweep_s = time.monotonic() - t1
+
+    verdicts: List[RolloutVerdict] = []
+    for row, (ti, pi) in enumerate(pairs):
+        v = valid[row]
+        S = int(v.sum())
+        step_h = traces[ti].step_s / 3600.0
+        slo = (~sat[row]) & v
+        # host-side f64 score, the exact sum sim.batch._verdicts computes —
+        # a frozen rollout's min_balancedness is bit-equal to fast_sweep's
+        bal = [
+            MAX_BALANCEDNESS_SCORE
+            - sum(costs[g] for g in goal_ids if viol[row, k, g] > 0)
+            for k in range(S)
+        ]
+        drawdown = np.maximum(needed[row] - alive[row], 0) * v
+        verdicts.append(
+            RolloutVerdict(
+                trace=traces[ti].name or f"trace-{ti}",
+                policy=policies[pi].name or f"policy-{pi}",
+                steps=S,
+                violation_steps=int(slo.sum()),
+                broker_hours=float((alive[row] * v).sum() * step_h),
+                scale_ups=int(((action[row] > 0) & v).sum()),
+                scale_downs=int(((action[row] < 0) & v).sum()),
+                max_drawdown=int(drawdown.max()),
+                peak_brokers=int((alive[row] * v).max()),
+                final_brokers=int(alive[row][S - 1]),
+                min_balancedness=float(min(bal)),
+                brokers_by_step=[int(x) for x in alive[row][:S]],
+                needed_by_step=[int(x) for x in needed[row][:S]],
+            )
+        )
+
+    result = RolloutResult(
+        verdicts=verdicts,
+        num_pairs=N,
+        num_steps=T,
+        bucket=(B_pad, base.num_replicas, base.num_partitions),
+        num_dispatches=1,
+        bucket_hit=hit,
+        duration_s=time.monotonic() - t0,
+    )
+    REGISTRY.counter(TRACE_ROLLOUTS_COUNTER).inc()
+    REGISTRY.counter(TRACE_PAIRS_COUNTER).inc(N)
+    REGISTRY.timer(TRACE_ROLLOUT_TIMER).update(result.duration_s)
+    obs.finish_trace(
+        token,
+        spans=[
+            obs.Span("build-batch", "setup", build_s, 0),
+            obs.Span("rollout", "sweep", sweep_s, 1),
+        ],
+        attrs={
+            "num_pairs": N,
+            "num_traces": len(traces),
+            "num_policies": len(policies),
+            "num_steps": T,
+            "bucket_brokers": B_pad,
+            "num_dispatches": result.num_dispatches,
+            "bucket_hit": hit,
+            "num_goals": len(goal_ids),
+            "cost": PROFILER.cost_since(cost_mark),
+        },
+    )
+    return result
+
+
+def horizon_requirements(
+    base: ClusterArrays,
+    trace: LoadTrace,
+    constraint: Optional[BalancingConstraint] = None,
+    goal_ids: Sequence[int] = G.DEFAULT_GOAL_ORDER,
+    hard_ids: Sequence[int] = G.HARD_GOALS,
+) -> dict:
+    """The RIGHTSIZE planning-horizon substrate (arxiv 1602.03770): evaluate
+    the trace at the CURRENT broker count (a frozen policy) and report the
+    peak min-brokers-needed over the horizon — capacity to pre-position
+    before the predicted peak, not after it hits."""
+    from cruise_control_tpu.traces.policy import frozen_policy
+
+    B = base.num_brokers
+    result = rollout(
+        base, [trace], [frozen_policy(B)],
+        constraint=constraint, goal_ids=goal_ids, hard_ids=hard_ids,
+        # headroom so "needed" can exceed the current size meaningfully
+        bucket_brokers=broker_bucket(max(B + 1, B * 2)),
+    )
+    v = result.verdicts[0]
+    needed = np.asarray(v.needed_by_step, np.int64)
+    peak_step = int(needed.argmax())
+    return {
+        "horizonSteps": v.steps,
+        "stepS": trace.step_s,
+        "currentBrokers": B,
+        "peakBrokersNeeded": int(needed.max()),
+        "peakStep": peak_step,
+        "brokersToAdd": max(int(needed.max()) - B, 0),
+        "violationSteps": v.violation_steps,
+        "numDispatches": result.num_dispatches,
+    }

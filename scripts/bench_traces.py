@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Benchmark the trace engine's batched-rollout wall + dispatch budget.
+
+The headline metric of the time-series scenario engine: **warm wall for a
+16-pair × 64-step batched autoscaling rollout** — N (trace × policy) pairs
+scanned through time as one compiled dispatch, the ``sim/`` bucket-ladder
+compile-amortization argument applied along the time axis.  The measurement
+harness lives in ``cruise_control_tpu/traces/bench.py`` (shared with the
+``traces`` tier of ``obs/gate.py`` and the acceptance tests, so the number
+the gate enforces is measured by the code that committed it).
+
+Regression gate (same pattern as ``scripts/bench_controller.py``): the
+measured warm wall is compared against the committed
+``benchmarks/BENCH_TRACES_cpu.json``; a >25 % regression (after an absolute
+noise floor, × ``CC_TPU_GATE_WALL_SLACK`` on shared runners) exits 1.  ANY
+XLA compile event attributed to the warm rollout's flight record also exits 1
+(warm rollout ⇒ zero compiles — the bucketed-shape contract), as does a warm
+dispatch count over the budget or a missed executable-shape bucket hit.
+
+    python scripts/bench_traces.py                     # run + gate
+    python scripts/bench_traces.py --update-baseline   # regenerate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SCHEMA = 1
+BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "BENCH_TRACES_cpu.json",
+)
+MAX_WALL_RATIO = 1.25
+WALL_FLOOR_S = 0.25
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="warm rollouts per run; best wall is gated (noise)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from cruise_control_tpu.core.compile_cache import configure_compile_cache
+    from cruise_control_tpu.traces import bench
+
+    configure_compile_cache()
+    doc = {"schema": SCHEMA, **bench.run_bench(warm_repeats=args.repeats)}
+    print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+
+    # contract violations are hard failures regardless of baseline: the
+    # batch layout itself regressed, not the machine
+    failures = []
+    if doc["warm_dispatches"] > doc["dispatch_budget"]:
+        failures.append(
+            f"{doc['warm_dispatches']} warm dispatches > budget "
+            f"{doc['dispatch_budget']} (one program for N pairs)"
+        )
+    if doc["warm_compile_events"]:
+        failures.append(
+            f"{doc['warm_compile_events']} XLA compile event(s) during the "
+            "warm rollout (warm rollout => zero compiles)"
+        )
+    if not doc["bucket_hit"]:
+        failures.append("warm rollout missed the executable-shape bucket")
+
+    if args.update_baseline:
+        if failures:
+            print("refusing to write a baseline from a contract-violating run:",
+                  file=sys.stderr)
+            for f_ in failures:
+                print(f"  - {f_}", file=sys.stderr)
+            return 2
+        with open(BASELINE, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"baseline written: {BASELINE}", file=sys.stderr)
+        return 0
+
+    if not os.path.exists(BASELINE):
+        print(f"missing baseline {BASELINE}; run --update-baseline",
+              file=sys.stderr)
+        return 2
+    with open(BASELINE) as f:
+        base = json.load(f)
+    if base.get("pairs") != doc["pairs"] or base.get("steps") != doc["steps"]:
+        print("workload mismatch vs baseline — regenerate it", file=sys.stderr)
+        return 2
+
+    slack = float(os.environ.get("CC_TPU_GATE_WALL_SLACK", "1.0"))
+    budget = base["warm_s"] * MAX_WALL_RATIO * slack + WALL_FLOOR_S
+    if doc["warm_s"] > budget:
+        failures.append(
+            f"warm wall {doc['warm_s']:.4f}s > budget {budget:.4f}s "
+            f"(baseline {base['warm_s']:.4f}s × {MAX_WALL_RATIO} × slack "
+            f"{slack} + {WALL_FLOOR_S}s floor)"
+        )
+    if failures:
+        print("TRACES REGRESSION:", file=sys.stderr)
+        for f_ in failures:
+            print(f"  - {f_}", file=sys.stderr)
+        return 1
+    print(
+        f"traces gate OK: warm {doc['warm_s']:.4f}s <= budget {budget:.4f}s, "
+        f"{doc['warm_dispatches']} dispatches, 0 warm compiles",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,12 @@ echo "== sharded tier (O(1)-collective census, replica-axis equivalence, warm 0-
 python -m pytest "tests/test_parallel.py::TestCollectiveAccounting" \
   "tests/test_parallel.py::TestSpmdSolverEquivalence" -x -q
 
+echo "== traces tier (time-series engine: trace DSL, batched rollouts, replay harness) =="
+python -m pytest tests/test_traces.py -x -q
+
+echo "== traces bench (16-pair x 64-step rollout: warm wall + 1-dispatch/0-compile vs committed baseline) =="
+python scripts/bench_traces.py >/dev/null
+
 echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check; incl. the sharded tier vs BENCH_SHARDED_8dev_virtual.json) =="
 python scripts/bench_gate.py
 

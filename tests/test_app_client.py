@@ -109,6 +109,9 @@ class TestClientRoundTrip:
         ks = client.kafka_cluster_state()
         assert ks
 
+    # ~33 s on the 1-core box (full optimize over HTTP); nightly slow tier —
+    # the dryrun/state/load round trips below keep the client seam fast
+    @pytest.mark.slow
     def test_rebalance_round_trip(self, client):
         out = client.rebalance(dryrun=True)
         assert out  # completed task payload
@@ -137,6 +140,8 @@ class TestClientRoundTrip:
 
 
 class TestProposalRefresher:
+    # ~30 s on the 1-core box (refresher runs a full optimize); nightly slow tier
+    @pytest.mark.slow
     def test_background_refresh_makes_proposals_instant(self, served_app, client):
         """GoalOptimizer.java:153 precompute: after the refresher populates the
         cache, GET /proposals answers from it (cached=true) without optimizing."""

@@ -21,6 +21,10 @@ from cruise_control_tpu.obs import RECORDER
 from cruise_control_tpu.sim import Scenario, deep_sweep
 from cruise_control_tpu.synthetic import SyntheticSpec, generate
 
+# ~5 min on the 1-core box (compiles BOTH full-goal-list program sets);
+# nightly slow tier + the gate's config1 dispatch budget cover the contract
+pytestmark = pytest.mark.slow
+
 #: deep_sweep runs GoalOptimizer(enable_heavy_goals=False): the heavy [B,T]
 #: goals drop out of the default list, and the dispatch budget follows
 N_GOALS = len([g for g in G.DEFAULT_GOAL_ORDER if g not in G.HEAVY_GOALS])

@@ -173,6 +173,7 @@ class TestRecorder:
         assert REGISTRY.gauge(FLIGHT_RING_GAUGE).snapshot() >= 1
 
 
+@pytest.mark.slow  # ~37 s class fixture (full optimize) on the 1-core box; nightly slow tier + the gate job cover dispatch accounting
 class TestOptimizeTrace:
     """ISSUE-1 acceptance: spans of a full optimize() account for every
     dispatch, on the deterministic fixture, through the JSONL sink."""
@@ -523,6 +524,7 @@ class TestGateEndToEnd:
             # one number, one file, regenerated by scripts/bench_*.py
             "controller": gate_mod._controller_baseline,
             "serving": gate_mod._serving_baseline,
+            "traces": gate_mod._traces_baseline,
         }
         for tier in gate_mod.DEFAULT_TIERS:
             if tier in artifact_baselines and tier not in doc["tiers"]:

@@ -148,6 +148,7 @@ class TestShardedSolver:
 # -- ISSUE 14: the O(1)-collective shard_map solver path ----------------------------
 
 
+@pytest.mark.slow  # ~65 s on the 1-core box; CI's sharded-tier step runs this class BY NAME (no -m filter), so coverage stays on every push
 class TestSpmdSolverEquivalence:
     """The shard_map fast path is semantics-free: placements, proposals and
     violations equal the single-device solver bit-for-bit — including shapes
@@ -297,6 +298,7 @@ class TestShardedSwapApply:
         )
 
 
+@pytest.mark.slow  # ~23 s on the 1-core box; CI's sharded-tier step runs this class BY NAME (no -m filter), so coverage stays on every push
 class TestCollectiveAccounting:
     """ISSUE 14 satellite: the 120-all-reduce GSPMD regression can't silently
     return — the sharded goal step's LOGICAL program must stay at a

@@ -141,6 +141,9 @@ class TestProvisionVerdicts:
         assert verdict.status == "UNDER_PROVISIONED"
         assert verdict.num_brokers_to_add >= 1
 
+    # ~95 s on the 1-core box (detector pass + provisioner = full optimize
+    # chain); nightly slow tier — the direct verdict tests above stay fast
+    @pytest.mark.slow
     def test_detector_feeds_provisioner_on_violation(self):
         backend, monitor, cc = build_cc()
         prov = BasicProvisioner()

@@ -772,8 +772,11 @@ class TestKillAndRestart:
             ) >= 2,
             desc="user-task completion records journaled",
         )
-        # app1 is now DROPPED: no app1.stop(), no journal close — the .open
-        # segments and the missing execution_finished record ARE the crash
+        # app1 is now DEAD: kill() takes its threads down the way a crash
+        # would — no journal close, no sealing — the .open segments and the
+        # missing execution_finished record ARE the crash (a dropped-but-
+        # running app would keep optimizing into later tests' flight records)
+        app1.kill()
 
         # ---- second life: same dirs, same (still-degraded) cluster ----------
         app2 = make_app(tmp_path, chaos)

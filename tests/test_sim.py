@@ -242,6 +242,7 @@ class TestFastSweepEquivalence:
             assert u.min_brokers_needed == s.min_brokers_needed
 
 
+@pytest.mark.slow  # ~55 s on the 1-core box (per-scenario full optimize loop); nightly slow tier
 class TestDeepSweep:
     GOALS = (G.RACK_AWARE, G.DISK_CAPACITY, G.REPLICA_DISTRIBUTION)
 
@@ -275,6 +276,7 @@ class TestDeepSweep:
         }
 
 
+@pytest.mark.slow  # ~110 s on the 1-core box (vmapped-solver program set); nightly slow tier + gate's deep tier
 class TestBatchedOptimize:
     """The vmapped full solver (GoalOptimizer.batched_optimize) and the
     batched deep_sweep built on it.  Same goal subset and 16-broker bucket as
@@ -438,6 +440,9 @@ class TestPlannerDeepVerify:
     GOALS = TestDeepSweep.GOALS
     HARD = (G.RACK_AWARE, G.DISK_CAPACITY)
 
+    # ~32 s on the 1-core box (deep verify = full optimize per probed edge);
+    # nightly slow tier — the refuted-window planner test below stays fast
+    @pytest.mark.slow
     def test_deep_verify_confirms_edge_and_reports(self):
         base = small_cluster()
         # max_extra_brokers=6 keeps every probe inside the module's shared

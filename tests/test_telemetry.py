@@ -264,6 +264,7 @@ class TestProfiler:
             assert "bytes_in_use" in row
 
 
+@pytest.mark.slow  # ~34 s class fixture (full warm optimize) on the 1-core box; nightly slow tier
 class TestWarmOptimizeWithProfiler:
     """Acceptance: the profiler adds NOTHING to the warm path — dispatch
     count and compile events unchanged (PR 4 budget) — while the optimize
@@ -389,6 +390,9 @@ def served():
 
 @pytest.mark.usefixtures("served")
 class TestServedTelemetry:
+    # ~33 s on the 1-core box (real HTTP rebalance = full optimize); nightly
+    # slow tier — the schema/lint/propagation units below stay fast
+    @pytest.mark.slow
     def test_request_id_walks_task_optimize_execution(self, served):
         """Acceptance: ONE X-Request-Id sent to POST REBALANCE retrieves the
         user task, the optimize trace and the execution trace."""

@@ -1,0 +1,542 @@
+"""traces/ subsystem: trace DSL, batched rollouts, and the replay harness.
+
+The load-bearing contracts:
+
+* a trace is seeded-deterministic DATA — identical wire forms materialize
+  identical factor arrays, and unknown wire keys are rejected loudly (same
+  contract as ``sim/scenario.py``);
+* the rollout is a LAYOUT, not an approximation — a frozen B=1 rollout's
+  per-step verdicts equal ``fast_sweep`` over the per-step scenarios the
+  trace itself emits (``scenario_at``), bit-for-bit;
+* a warm batched rollout of ≥16 (trace × policy) pairs over a ≥64-step
+  trace is ONE compiled dispatch with zero recompiles, asserted from the
+  ``kind="rollout"`` flight record;
+* the replay harness drives a drift storm through the REAL continuous
+  controller on a fake clock: at least one publish, at most one per phase
+  (no thrash), reaction latency an exact multiple of the tick quantum, and
+  zero warm compiles.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.core.sensors import (
+    MONITOR_LISTENER_ERRORS_COUNTER,
+    REGISTRY,
+)
+from cruise_control_tpu.model.arrays import broker_bucket
+from cruise_control_tpu.obs import RECORDER
+from cruise_control_tpu.sim import Scenario, fast_sweep
+from cruise_control_tpu.synthetic import SyntheticSpec, generate
+from cruise_control_tpu.traces.policy import (
+    AutoscalePolicy,
+    frozen_policy,
+    pack_policies,
+    policies_from_wire,
+)
+from cruise_control_tpu.traces.replay import TICK_QUANTUM_S, FakeClock, run_replay
+from cruise_control_tpu.traces.rollout import horizon_requirements, rollout
+from cruise_control_tpu.traces.trace import (
+    LoadTrace,
+    TraceSegment,
+    diurnal_trace,
+    drift_storm_trace,
+    ramp_trace,
+    spike_trace,
+    traces_from_wire,
+)
+from tests import fixtures
+
+LIGHT = dict(mean_cpu=0.08, mean_disk=0.08, mean_nw_in=0.08, mean_nw_out=0.06)
+
+
+def small_cluster(seed=2, **kw):
+    spec = SyntheticSpec(
+        num_racks=5, num_brokers=10, num_topics=5, num_partitions=50,
+        replication_factor=2, seed=seed, **{**LIGHT, **kw},
+    )
+    return generate(spec)[0]
+
+
+# -- the trace DSL ------------------------------------------------------------
+
+
+class TestTraceDSL:
+    def test_wire_roundtrip(self):
+        tr = LoadTrace(
+            name="mix", num_steps=48, step_s=1800.0, base_factor=1.2, seed=7,
+            segments=(
+                TraceSegment(kind="diurnal", amplitude=0.3, period=24),
+                TraceSegment(kind="ramp", start=8, steps=16, rate=0.05),
+                TraceSegment(kind="spike", start=20, magnitude=2.0, decay=0.6),
+                TraceSegment(kind="topic_spike", start=4, steps=4, topic=1,
+                             magnitude=3.0),
+                TraceSegment(kind="topic_growth", topic=0, rate=0.01),
+                TraceSegment(kind="noise", sigma=0.02),
+            ),
+        )
+        rt = LoadTrace.from_dict(json.loads(json.dumps(tr.to_dict())))
+        assert rt == tr
+
+    def test_seeded_determinism(self):
+        """Same wire form → identical arrays; different seed → different."""
+        tr = diurnal_trace(num_steps=32, amplitude=0.4, sigma=0.1, seed=11)
+        a = tr.materialize(3)
+        b = LoadTrace.from_dict(tr.to_dict()).materialize(3)
+        np.testing.assert_array_equal(a.global_factor, b.global_factor)
+        np.testing.assert_array_equal(a.topic_factor, b.topic_factor)
+        c = diurnal_trace(num_steps=32, amplitude=0.4, sigma=0.1, seed=12)
+        assert not np.array_equal(
+            a.global_factor, c.materialize(3).global_factor
+        )
+
+    def test_factor_floor(self):
+        """Destructive interference can't drive the factor non-positive."""
+        tr = LoadTrace(
+            num_steps=8,
+            segments=(TraceSegment(kind="ramp", rate=-10.0),),
+        )
+        arrs = tr.materialize(2)
+        assert float(arrs.global_factor.min()) > 0.0
+
+    def test_scenario_at_is_f32_exact(self):
+        """A step's Scenario carries the float32-exact factors, so the wire
+        round-trip through SIMULATE agrees with the rollout kernel."""
+        tr = diurnal_trace(num_steps=8, amplitude=0.4, seed=3)
+        arrs = tr.materialize(2)
+        sc = tr.scenario_at(arrs, 5)
+        assert sc.load_factor == float(arrs.global_factor[5])
+        assert np.float32(sc.load_factor) == arrs.global_factor[5]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TraceSegment(kind="nope").validate()
+        with pytest.raises(ValueError):
+            TraceSegment(kind="diurnal", period=0).validate()
+        with pytest.raises(ValueError):
+            TraceSegment(kind="spike", decay=1.5).validate()
+        with pytest.raises(ValueError):
+            TraceSegment(kind="topic_spike", magnitude=2.0).validate()  # no topic
+        with pytest.raises(ValueError):
+            LoadTrace(num_steps=0).validate()
+        with pytest.raises(ValueError):
+            LoadTrace(num_steps=4, step_s=0.0).validate()
+        with pytest.raises(ValueError):
+            # topic out of range surfaces at materialize time
+            LoadTrace(
+                num_steps=4,
+                segments=(TraceSegment(kind="topic_spike", topic=9,
+                                       magnitude=2.0),),
+            ).materialize(2)
+
+    def test_unknown_wire_keys_rejected(self):
+        """Strict wire contract — same as sim/scenario.py (and the Scenario
+        regression rides along: its wire parser shares check_wire_keys)."""
+        with pytest.raises(ValueError, match="unknown"):
+            TraceSegment.from_dict({"kind": "ramp", "slope": 0.1})
+        with pytest.raises(ValueError, match="unknown"):
+            LoadTrace.from_dict({"num_steps": 4, "length": 4})
+        with pytest.raises(ValueError, match="unknown"):
+            AutoscalePolicy.from_dict({"scale_out_thresh": 0.9})
+        with pytest.raises(ValueError, match="unknown"):
+            Scenario.from_dict({"name": "x", "add_broker": 2})
+
+    def test_wire_list_parsers(self):
+        traces = traces_from_wire([diurnal_trace(num_steps=4).to_dict()])
+        assert traces[0].num_steps == 4
+        policies = policies_from_wire([AutoscalePolicy(name="p").to_dict()])
+        assert policies[0].name == "p"
+        with pytest.raises(ValueError):
+            traces_from_wire({"not": "a list"})
+        with pytest.raises(ValueError):
+            policies_from_wire("nope")
+
+
+class TestPolicySpec:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(scale_out_threshold=0.0).validate()
+        with pytest.raises(ValueError):
+            AutoscalePolicy(scale_in_threshold=0.9,
+                            scale_out_threshold=0.8).validate()
+        with pytest.raises(ValueError):
+            AutoscalePolicy(step_brokers=0).validate()
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_brokers=4, max_brokers=2).validate()
+
+    def test_pack_resolves_defaults(self):
+        """0-defaults resolve to base size / bucket capacity, clamped."""
+        packed = pack_policies(
+            [AutoscalePolicy(), AutoscalePolicy(max_brokers=64,
+                                                initial_brokers=100)],
+            base_brokers=10, bucket=16,
+        )
+        assert packed["init_b"][0] == 10       # base size
+        assert packed["max_b"][0] == 16        # bucket capacity
+        assert packed["max_b"][1] == 16        # clamped to bucket
+        assert packed["init_b"][1] == 16       # clamped into [min, max]
+
+    def test_frozen_policy_never_acts(self):
+        p = frozen_policy(7)
+        assert p.min_brokers == p.max_brokers == p.initial_brokers == 7
+
+
+# -- rollout equivalence ------------------------------------------------------
+
+
+class TestRolloutEquivalence:
+    def test_frozen_rollout_equals_fast_sweep(self):
+        """B=1 bit-equality: a frozen rollout's per-step verdicts equal
+        fast_sweep over the per-step scenarios the trace itself emits.
+        The batch/scan is a layout, not an approximation."""
+        state, _ = fixtures.unbalanced2().to_arrays()
+        B = state.num_brokers
+        tr = diurnal_trace(amplitude=0.5, num_steps=8, seed=3)
+        arrs = tr.materialize(state.num_topics)
+        bucket = broker_bucket(B)
+
+        res = rollout(state, [tr], [frozen_policy(B)], bucket_brokers=bucket)
+        v = res.verdicts[0]
+
+        scens = [tr.scenario_at(arrs, k) for k in range(arrs.num_steps)]
+        sweep = fast_sweep(state, scens, bucket_brokers=bucket)
+
+        assert [s.min_brokers_needed for s in sweep.scenarios] == v.needed_by_step
+        assert sum(
+            0 if s.satisfiable else 1 for s in sweep.scenarios
+        ) == v.violation_steps
+        # exact equality — the rollout computes the score with the same
+        # host-side float algebra as sim.batch._verdicts
+        assert min(s.balancedness for s in sweep.scenarios) == v.min_balancedness
+        assert v.brokers_by_step == [B] * arrs.num_steps
+        assert v.scale_ups == 0 and v.scale_downs == 0
+
+    def test_mixed_trace_lengths_masked(self):
+        """Shorter traces pad with 1.0 and the tail is masked out of every
+        aggregate — broker-hours count only real steps."""
+        state = small_cluster()
+        short = ramp_trace(name="short", num_steps=4, rate=0.0)
+        long = ramp_trace(name="long", num_steps=12, rate=0.0)
+        res = rollout(state, [short, long], [frozen_policy(state.num_brokers)])
+        by_trace = {v.trace: v for v in res.verdicts}
+        assert by_trace["short"].steps == 4
+        assert by_trace["long"].steps == 12
+        hours = state.num_brokers * short.step_s / 3600.0
+        assert by_trace["short"].broker_hours == pytest.approx(hours * 4)
+        assert by_trace["long"].broker_hours == pytest.approx(hours * 12)
+        assert len(by_trace["short"].brokers_by_step) == 4
+
+    def test_policy_scales_out_under_ramp(self):
+        """A steep ramp forces scale-outs; the frozen policy racks up
+        violation steps the reactive policy avoids at the peak."""
+        state = small_cluster(mean_disk=0.5)
+        tr = ramp_trace(num_steps=16, rate=0.25)
+        reactive = AutoscalePolicy(
+            name="reactive", scale_out_threshold=0.7, scale_in_threshold=0.2,
+            cooldown_ticks=0, step_brokers=2, max_brokers=32,
+        )
+        res = rollout(
+            state, [tr], [frozen_policy(state.num_brokers), reactive],
+            bucket_brokers=32,
+        )
+        frozen_v = next(v for v in res.verdicts if v.policy == "frozen")
+        react_v = next(v for v in res.verdicts if v.policy == "reactive")
+        assert react_v.scale_ups > 0
+        assert react_v.peak_brokers > state.num_brokers
+        assert react_v.violation_steps <= frozen_v.violation_steps
+        assert react_v.max_drawdown <= frozen_v.max_drawdown
+
+    def test_cooldown_gates_actions(self):
+        """cooldown_ticks=k → at most one action per k+1 steps."""
+        state = small_cluster(mean_disk=0.5)
+        tr = ramp_trace(num_steps=12, rate=0.3)
+        eager = AutoscalePolicy(
+            name="eager", cooldown_ticks=0, step_brokers=1, max_brokers=32,
+            scale_out_threshold=0.7, scale_in_threshold=0.1,
+        )
+        cooled = AutoscalePolicy(
+            name="cooled", cooldown_ticks=3, step_brokers=1, max_brokers=32,
+            scale_out_threshold=0.7, scale_in_threshold=0.1,
+        )
+        res = rollout(state, [tr], [eager, cooled], bucket_brokers=32)
+        by = {v.policy: v for v in res.verdicts}
+        acts = by["cooled"].scale_ups + by["cooled"].scale_downs
+        assert acts <= (12 + 3) // 4  # one action per cooldown+1 steps
+        assert by["eager"].scale_ups >= by["cooled"].scale_ups
+
+    def test_min_max_bounds_hold(self):
+        state = small_cluster()
+        tr = spike_trace(num_steps=10, at=2, magnitude=6.0, decay=0.9)
+        bounded = AutoscalePolicy(
+            name="bounded", min_brokers=8, max_brokers=12, cooldown_ticks=0,
+            step_brokers=4, scale_out_threshold=0.6, scale_in_threshold=0.5,
+        )
+        res = rollout(state, [tr], [bounded], bucket_brokers=16)
+        v = res.verdicts[0]
+        assert all(8 <= b <= 12 for b in v.brokers_by_step)
+
+    def test_winners_prefers_cheapest_violation_free(self):
+        state = small_cluster()
+        tr = ramp_trace(name="flat", num_steps=6, rate=0.0)
+        big = frozen_policy(10, name="big")
+        small = AutoscalePolicy(
+            name="small", min_brokers=8, max_brokers=8, initial_brokers=8,
+            cooldown_ticks=0,
+        )
+        res = rollout(state, [tr], [big, small], bucket_brokers=16)
+        by = {v.policy: v for v in res.verdicts}
+        win = res.winners()
+        free = [p for p, v in by.items() if v.violation_free]
+        if free:
+            cheapest = min(free, key=lambda p: by[p].broker_hours)
+            assert win["flat"] == cheapest
+        else:
+            assert win["flat"] is None
+
+    def test_horizon_requirements(self):
+        """RIGHTSIZE substrate: peak min-brokers-needed over the horizon at
+        the current size, with headroom so 'needed' can exceed it."""
+        state = small_cluster(mean_disk=0.5)
+        tr = spike_trace(num_steps=8, at=4, magnitude=4.0, decay=0.5)
+        h = horizon_requirements(state, tr)
+        assert h["horizonSteps"] == 8
+        assert h["currentBrokers"] == state.num_brokers
+        assert h["peakBrokersNeeded"] >= 1
+        assert h["peakStep"] in range(8)
+        assert h["brokersToAdd"] == max(
+            h["peakBrokersNeeded"] - state.num_brokers, 0
+        )
+        assert h["numDispatches"] == 1
+
+
+# -- the acceptance contract --------------------------------------------------
+
+
+class TestRolloutAcceptance:
+    def test_batched_rollout_one_dispatch_no_warm_recompile(self):
+        """≥16 (trace × policy) pairs over a ≥64-step trace: the warm rollout
+        is ≤2 dispatches with zero attributed XLA compiles and an executable
+        bucket hit, asserted from the kind="rollout" flight record."""
+        state = small_cluster()
+        traces = [
+            diurnal_trace(name="diurnal", num_steps=64, amplitude=0.4),
+            ramp_trace(name="ramp", num_steps=64, rate=0.02),
+            spike_trace(name="spike", num_steps=64, at=16, magnitude=1.5),
+            diurnal_trace(name="noisy", num_steps=64, amplitude=0.3,
+                          sigma=0.05, seed=9),
+        ]
+        policies = [
+            AutoscalePolicy(name=f"p{i}", scale_out_threshold=0.6 + 0.08 * i,
+                            scale_in_threshold=0.3, cooldown_ticks=i,
+                            step_brokers=1 + i % 2, max_brokers=16)
+            for i in range(4)
+        ]
+        cold = rollout(state, traces, policies, bucket_brokers=16)
+        assert cold.num_pairs == 16
+        assert cold.num_steps == 64
+
+        warm = rollout(state, traces, policies, bucket_brokers=16)
+        assert warm.bucket_hit is True
+
+        record = RECORDER.recent(1, kind="rollout")[0]
+        assert record.attrs["num_pairs"] == 16
+        assert record.attrs["num_steps"] == 64
+        assert record.attrs["num_dispatches"] <= 2
+        assert record.attrs["bucket_hit"] is True
+        # warm = zero attributed XLA compiles
+        assert record.compile_events == []
+        # cold/warm verdicts identical (determinism across dispatches)
+        for a, b in zip(cold.verdicts, warm.verdicts):
+            assert a.needed_by_step == b.needed_by_step
+            assert a.brokers_by_step == b.brokers_by_step
+            assert a.min_balancedness == b.min_balancedness
+
+
+# -- replay harness -----------------------------------------------------------
+
+
+class TestReplay:
+    def test_fake_clock(self):
+        clock = FakeClock(start=5.0)
+        assert clock() == 5.0
+        clock.advance(2.5)
+        assert clock() == 7.5
+        with pytest.raises(ValueError):
+            clock.advance(-1.0)
+
+    def test_drift_storm_reacts_without_thrash(self):
+        """A 3-phase drift storm through the REAL controller on a fake
+        clock: ≥1 publish, ≤1 per phase (no thrash), exact reaction
+        latency, zero warm compiles."""
+        phases, hold = 3, 3
+        tr = drift_storm_trace(phases=phases, hold=hold, magnitude=20.0)
+        report = run_replay(tr)
+
+        assert report.steps == phases * hold
+        assert report.windows_fed == 2 * report.steps
+        # the storm is rebalance-fixable by construction: the controller
+        # must react at least once, and at most once per phase
+        assert report.published >= 1
+        assert report.published <= phases
+        assert report.final_version == report.published
+        # reaction latency is exact on the fake clock: a whole number of
+        # tick quanta, and at least one (evidence lands before the tick)
+        assert report.reactions, "no reaction latency recorded"
+        for r in report.reactions:
+            assert r >= TICK_QUANTUM_S
+            assert r == pytest.approx(
+                round(r / TICK_QUANTUM_S) * TICK_QUANTUM_S, abs=1e-9
+            )
+        assert report.max_reaction_s == max(report.reactions)
+        # ticks after the first publish must not compile
+        assert report.warm_compile_events == 0
+        assert report.total_dispatches > 0
+
+        # the flight record nests per-step ticks under the replay trace
+        replay_rec = RECORDER.recent(1, kind="replay")[0]
+        assert replay_rec.attrs["published"] == report.published
+        ticks = RECORDER.recent(
+            report.steps + 4, kind="controller_tick",
+            parent_id=replay_rec.trace_id,
+        )
+        assert len(ticks) == report.steps
+
+    def test_quiet_trace_does_not_churn(self):
+        """A flat trace may earn ONE publish (the base placement's initial
+        imbalance is real evidence) but never a second — re-publishing on
+        unchanged load is thrash."""
+        tr = LoadTrace(name="flat", num_steps=6, step_s=60.0)
+        report = run_replay(tr)
+        assert report.published <= 1
+        assert report.final_version == report.published
+        # whatever was published landed on the first evidence, not later
+        late = [o for o in report.outcomes[2:] if o.published]
+        assert late == []
+
+
+# -- monitor listener-error accounting ---------------------------------------
+
+
+class TestListenerErrors:
+    def test_raising_listener_counted_and_isolated(self):
+        """A listener that raises must not break sampling or starve the
+        listeners behind it; each failure lands in the
+        LoadMonitor.listener-errors sensor."""
+        from cruise_control_tpu.backend import FakeClusterBackend
+        from cruise_control_tpu.core.resources import Resource
+        from cruise_control_tpu.monitor import (
+            BackendMetricSampler,
+            LoadMonitor,
+            StaticCapacityResolver,
+        )
+
+        backend = FakeClusterBackend()
+        backend.add_broker(0, rack="0")
+        backend.create_partition(("T", 0), [0], load=[1.0, 1.0, 1.0, 1.0])
+        monitor = LoadMonitor(
+            backend,
+            BackendMetricSampler(backend),
+            StaticCapacityResolver({r: 1e9 for r in Resource}),
+            num_windows=2,
+            window_ms=1_000,
+        )
+        calls = []
+
+        def bad(batch):
+            raise RuntimeError("boom")
+
+        monitor.add_window_listener(bad)
+        monitor.add_window_listener(lambda batch: calls.append(batch))
+
+        before = REGISTRY.counter(MONITOR_LISTENER_ERRORS_COUNTER).value
+        for w in range(4):
+            monitor.sample_once(now_ms=(w + 1) * 1_000)
+        after = REGISTRY.counter(MONITOR_LISTENER_ERRORS_COUNTER).value
+
+        assert after > before          # failures were counted...
+        assert calls                   # ...the next listener still ran
+        # ...and sampling survived: every later ingest was still accepted
+        assert monitor.state().last_sample_ts_ms == 4_000
+
+
+# -- the REST surface ---------------------------------------------------------
+
+
+class TestTracesEndpoint:
+    @pytest.fixture()
+    def app(self):
+        from cruise_control_tpu.detector.provisioner import BasicProvisioner
+        from tests.test_api import build_app
+
+        return build_app(provisioner=BasicProvisioner())
+
+    def _post(self, app, endpoint, params, deadline_s=180.0):
+        import time as _time
+
+        status, body, headers = app.handle("POST", endpoint, params, {})
+        deadline = _time.monotonic() + deadline_s
+        while status == 202:
+            assert _time.monotonic() < deadline, "async op timed out"
+            _time.sleep(0.1)
+            task_id = headers["User-Task-ID"]
+            status, body, headers = app.handle(
+                "POST", endpoint, params, {"User-Task-ID": task_id}
+            )
+        return status, body
+
+    def test_post_traces_rollout(self, app):
+        from cruise_control_tpu.api import schemas
+
+        traces = [
+            diurnal_trace(name="d", num_steps=8, amplitude=0.3).to_dict(),
+            ramp_trace(name="r", num_steps=8, rate=0.05).to_dict(),
+        ]
+        policies = [
+            frozen_policy(4).to_dict(),
+            AutoscalePolicy(name="auto", cooldown_ticks=1,
+                            max_brokers=8).to_dict(),
+        ]
+        status, body = self._post(app, "TRACES", {
+            "traces": [json.dumps(traces)],
+            "policies": [json.dumps(policies)],
+        })
+        assert status == 200
+        schemas.validate_endpoint("POST TRACES", body)
+        assert body["rollout"]["numPairs"] == 4
+        assert body["rollout"]["numDispatches"] <= 2
+        assert {v["trace"] for v in body["verdicts"]} == {"d", "r"}
+        assert set(body["winners"]) == {"d", "r"}
+
+    def test_post_traces_requires_params(self, app):
+        status, body, _ = app.handle("POST", "TRACES", {}, {})
+        assert status >= 400
+        assert "error" in body
+
+    def test_post_traces_rejects_bad_wire(self, app):
+        status, body = self._post(app, "TRACES", {
+            "traces": [json.dumps([{"num_steps": 4, "bogus": 1}])],
+            "policies": [json.dumps([frozen_policy(4).to_dict()])],
+        })
+        assert status >= 400
+
+    def test_get_traces_still_serves_flight_records(self, app):
+        status, body, _ = app.handle("GET", "TRACES", {}, {})
+        assert status == 200
+        assert "traces" in body and "recorder" in body
+
+    def test_rightsize_horizon(self, app):
+        from cruise_control_tpu.api import schemas
+
+        tr = spike_trace(name="peak", num_steps=6, at=2, magnitude=2.0)
+        status, body = self._post(app, "RIGHTSIZE", {
+            "dryrun": ["true"],
+            "trace": [json.dumps(tr.to_dict())],
+        })
+        assert status == 200
+        schemas.validate_endpoint("RIGHTSIZE", body)
+        h = body["horizon"]
+        assert h["horizonSteps"] == 6
+        assert h["currentBrokers"] == 4
+        assert h["brokersToAdd"] == max(h["peakBrokersNeeded"] - 4, 0)
