@@ -86,7 +86,7 @@ ANONYMOUS_PRINCIPAL = "(anonymous)"
 CHEAP_ENDPOINTS = {
     "HEALTHZ", "METRICS", "STATE", "TRACES", "USER_TASKS", "PERMISSIONS",
     "REVIEW_BOARD", "CONTROLLER", "ADMIN", "REVIEW",
-    "STOP_PROPOSAL_EXECUTION",
+    "STOP_PROPOSAL_EXECUTION", "WATCH",
 }
 
 #: endpoint class ranks for queue priority (lower = drains first): cluster

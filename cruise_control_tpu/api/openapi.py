@@ -132,6 +132,21 @@ _ENDPOINT_PARAMS = {
                          "ready completes; default liveness mode always "
                          "answers 200 with the ladder state in the body")},
     ],
+    "WATCH": [
+        {"name": "since", "in": "query", "required": False,
+         "schema": {"type": "integer"},
+         "description": ("delta cursor: last seq this client has seen "
+                         "(0 = from the start of the ring; a cursor past "
+                         "the ring answers resync=true + a snapshot of the "
+                         "current standing set)"),
+         "methods": ["get"]},
+        {"name": "timeout_ms", "in": "query", "required": False,
+         "schema": {"type": "integer"},
+         "description": ("long-poll park time when no delta is pending "
+                         "(capped by replication.watch.max.wait.ms; 0 = "
+                         "answer immediately)"),
+         "methods": ["get"]},
+    ],
     "CONTROLLER": [
         {"name": "action", "in": "query", "required": False,
          "schema": {"type": "string", "enum": ["pause", "resume", "tick"]},

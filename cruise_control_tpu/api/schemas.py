@@ -119,6 +119,7 @@ _CONTROLLER_STATUS = {
     "?warmed": bool,
     "?stalenessS": float,
     "?stale": bool,
+    "?epoch": int,                   # fenced writer regime (0 = unfenced)
     "?drift": float,
     "?balancedness": (float, None),
     "?violatedGoals": [str],
@@ -160,6 +161,17 @@ _ADMISSION = {
     "maxConcurrent": int,
     "rateQps": float,
     "maxTasksPerPrincipal": int,
+}
+
+#: the per-read replication stamp (replication/state.py): present on every
+#: dict GET answer when the process carries a ReplicationState — how current
+#: the answer is, and under which fenced writer regime
+_REPLICATION_STAMP = {
+    "setVersion": int,
+    "epoch": int,
+    "stalenessMs": int,
+    "degraded": bool,
+    "role": str,                     # writer | follower
 }
 
 #: STATE.Breaker (backend/breaker.py): the circuit-breaker state machine
@@ -269,6 +281,31 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
     "USER_TASKS": {"userTasks": [_USER_TASK]},
     "REVIEW_BOARD": {"requestInfo": [dict]},
     "PERMISSIONS": {"role": str},
+    #: long-poll watch over the standing proposal set (replication/):
+    #: deltas since the client's cursor, plus the per-read replication stamp
+    "WATCH": {
+        "deltas": [
+            {
+                "seq": int,
+                "kind": str,        # published | superseded | drained | epoch
+                "version": int,
+                "epoch": int,
+                "tsMs": int,
+                "?numProposals": int,
+                "?trigger": str,
+                "?drift": float,
+                "?superseded": int,
+                "?reason": (str, None),
+                "?completed": (int, None),
+            }
+        ],
+        #: the cursor to re-arm with (last delta seq on this process)
+        "since": int,
+        #: true when the cursor predated the delta ring: the single delta is
+        #: a snapshot of the current set, not the missed history
+        "resync": bool,
+        "replication": _REPLICATION_STAMP,
+    },
     "BOOTSTRAP": {"samplesLoaded": int, "from": int, "to": int},
     "TRAIN": {"trained": bool},
     "TRACES": {

@@ -65,7 +65,7 @@ API_PREFIX = "/kafkacruisecontrol/"
 GET_ENDPOINTS = {
     "STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE",
     "USER_TASKS", "REVIEW_BOARD", "PERMISSIONS", "BOOTSTRAP", "TRAIN",
-    "TRACES", "METRICS", "HEALTHZ", "CONTROLLER",
+    "TRACES", "METRICS", "HEALTHZ", "CONTROLLER", "WATCH",
 }
 #: endpoints whose 200 body is plain text, not JSON (Prometheus exposition)
 TEXT_ENDPOINTS = {"METRICS"}
@@ -314,6 +314,8 @@ class CruiseControlApp:
         admission: Optional[AdmissionController] = None,
         breaker=None,
         max_active_user_tasks: int = 25,
+        replication=None,
+        replication_opts: Optional[dict] = None,
     ) -> None:
         self.cc = cruise_control
         self.anomaly_manager = anomaly_manager
@@ -332,6 +334,23 @@ class CruiseControlApp:
         #: shared backend circuit breaker (backend/breaker.py), None = no
         #: breaker on this seam (embedded/test construction)
         self.breaker = breaker
+        #: replicated standing-set view (replication/state.py): present on
+        #: followers (fed by the WAL tailer) and on writers with the
+        #: controller journal listener wired; None in embedded/test
+        #: construction — WATCH then 404s and reads go unstamped
+        self.replication = replication
+        opts = dict(replication_opts or {})
+        self.replication_lag_bound_ms = int(opts.get("lag.bound.ms", 5_000))
+        self.replication_degraded_after_ms = int(
+            opts.get("degraded.after.ms", 10_000)
+        )
+        self.replication_watch_max_wait_ms = int(
+            opts.get("watch.max.wait.ms", 30_000)
+        )
+        #: follower processes serve reads only — every POST is refused with
+        #: a pointer at the writer (split-brain guard: even a confused
+        #: client cannot make a follower mutate anything)
+        self.read_only = replication is not None and not replication.writer
         self.user_tasks = UserTaskManager(
             journal=user_task_journal, max_active_tasks=max_active_user_tasks
         )
@@ -573,6 +592,57 @@ class CruiseControlApp:
         if self.controller is None:
             return 200, {"enabled": False}
         return 200, {"enabled": True, **self.controller.status()}
+
+    def get_watch(self, params) -> Tuple[int, dict]:
+        """Long-poll watch over the standing proposal set: standing-set
+        deltas (published/superseded/drained, keyed by version) since the
+        client's cursor, instead of the USER_TASKS polling loop.
+
+        ``since`` — last delta seq the client has seen (0 = from the start
+        of the ring); ``timeout_ms`` — how long to park when no delta is
+        pending (capped by replication.watch.max.wait.ms; 0 = answer
+        immediately).  A cursor that fell off the bounded ring gets
+        ``resync=true`` + a snapshot delta of the current set — slow
+        watchers converge, they don't error."""
+        from cruise_control_tpu.core.sensors import (
+            REGISTRY,
+            REPLICATION_WATCHERS_GAUGE,
+        )
+        from cruise_control_tpu.obs import recorder as obs
+
+        if self.replication is None:
+            return 404, {"error": "replication is not enabled on this process"}
+        try:
+            since = int(params.get("since", ["0"])[0])
+            timeout_ms = int(params.get("timeout_ms", ["0"])[0])
+            if since < 0 or timeout_ms < 0:
+                raise ValueError
+        except ValueError:
+            return 400, {
+                "error": "since and timeout_ms must be non-negative integers"
+            }
+        timeout_ms = min(timeout_ms, self.replication_watch_max_wait_ms)
+        token = obs.start_trace("watch")
+        gauge = REGISTRY.gauge(REPLICATION_WATCHERS_GAUGE)
+        gauge.set(gauge.value + 1)
+        try:
+            deltas, next_since, resync = self.replication.watch(
+                since, timeout_ms / 1000.0
+            )
+        finally:
+            gauge.set(max(0.0, gauge.value - 1))
+            obs.finish_trace(
+                token,
+                attrs={"since": since, "timeout_ms": timeout_ms},
+            )
+        return 200, {
+            "deltas": deltas,
+            "since": next_since,
+            "resync": resync,
+            "replication": self.replication.stamp(
+                self.replication_degraded_after_ms
+            ),
+        }
 
     def get_train(self, params) -> Tuple[int, dict]:
         start = int(params.get("start", ["0"])[0])
@@ -1014,9 +1084,72 @@ class CruiseControlApp:
             self._retry_after_header(retry_s),
         )
 
+    #: endpoints a lagging follower still answers: process-local state
+    #: (liveness, telemetry, flight recorder), not replicated data — a 503
+    #: here would blind the operator exactly when they need the gauges
+    REPLICATION_LAG_EXEMPT = {"HEALTHZ", "METRICS", "TRACES", "PERMISSIONS"}
+
+    def _replication_read_gate(
+        self, endpoint: str
+    ) -> Optional[Tuple[int, dict, Dict[str, str]]]:
+        """Follower staleness contract: past the lag bound, replicated reads
+        answer 503 + Retry-After (PR 8 shed discipline) — never
+        silently-stale data.  Returns None when the read may proceed."""
+        from cruise_control_tpu.core.sensors import (
+            REGISTRY,
+            REPLICATION_STALE_503_COUNTER,
+        )
+
+        stale_ms = self.replication.staleness_ms()
+        if stale_ms <= self.replication_lag_bound_ms:
+            return None
+        REGISTRY.counter(REPLICATION_STALE_503_COUNTER).inc()
+        # the tail is not keeping up (writer-side disk stall, follower I/O
+        # starvation): back clients off proportionally to the lag, bounded
+        # like the breaker's probe window
+        retry_s = min(30.0, max(1.0, stale_ms / 1000.0))
+        return (
+            503,
+            {
+                "error": (
+                    f"{endpoint}: follower is {stale_ms} ms behind the WAL "
+                    f"(lag bound {self.replication_lag_bound_ms} ms)"
+                ),
+                "replication": self.replication.stamp(
+                    self.replication_degraded_after_ms
+                ),
+            },
+            self._retry_after_header(retry_s),
+        )
+
     def _dispatch_authorized(
         self, method: str, endpoint: str, params: Dict[str, List[str]], user, role
     ) -> Tuple[int, Union[dict, str], Dict[str, str]]:
+        # follower role (replication.role=follower): reads only.  POSTs are
+        # refused outright — exactly one fenced writer owns mutation, and a
+        # follower must stay incapable of split-brain even when misaddressed
+        if self.read_only:
+            if method == "POST":
+                return (
+                    503,
+                    {
+                        "error": (
+                            f"{endpoint}: this process is a replication "
+                            "follower (reads + WATCH only); send mutations "
+                            "to the writer"
+                        ),
+                        "replication": self.replication.stamp(
+                            self.replication_degraded_after_ms
+                        ),
+                    },
+                    self._retry_after_header(
+                        self.admission.retry_after_estimate()
+                    ),
+                )
+            if endpoint not in self.REPLICATION_LAG_EXEMPT:
+                refused = self._replication_read_gate(endpoint)
+                if refused is not None:
+                    return refused
         # admission: the token bucket is the first, cheapest refusal — it
         # must fire before any readiness/breaker/model work (overload
         # protection that itself does work per request protects nothing).
@@ -1063,11 +1196,21 @@ class CruiseControlApp:
             if method == "GET":
                 if endpoint == "PERMISSIONS":
                     status, body = self.get_permissions(params, role=role)
-                    return status, body, {}
-                fn = getattr(self, f"get_{endpoint.lower()}", None)
-                if fn is None:
-                    return 404, {"error": f"unknown endpoint {endpoint}"}, {}
-                status, body = fn(params)
+                else:
+                    fn = getattr(self, f"get_{endpoint.lower()}", None)
+                    if fn is None:
+                        return 404, {"error": f"unknown endpoint {endpoint}"}, {}
+                    status, body = fn(params)
+                if self.replication is not None and isinstance(body, dict):
+                    # every read carries {setVersion, epoch, stalenessMs,
+                    # degraded}: clients can always tell how current the
+                    # answer is (schemas allow additive keys)
+                    body.setdefault(
+                        "replication",
+                        self.replication.stamp(
+                            self.replication_degraded_after_ms
+                        ),
+                    )
                 return status, body, {}
 
             # POST: two-step verification parks reviewable requests

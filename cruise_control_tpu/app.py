@@ -226,14 +226,25 @@ class CruiseControlTpuApp:
         # and the user-task WAL live side by side under one base directory so
         # "restart on the same dirs" is one knob.  Empty = durability off.
         jdir = cfg.get("journal.dir") or ""
+        #: replication.role: 'writer' owns the WALs and the control loop;
+        #: 'follower' tails the writer's controller WAL read-only and never
+        #: opens a journal for writing (two processes appending to one WAL
+        #: would be exactly the split-brain the epoch fence exists to stop)
+        self.replication_role = cfg.get("replication.role")
+        if self.replication_role == "follower" and not jdir:
+            raise ValueError(
+                "replication.role=follower requires journal.dir (the WAL "
+                "the follower tails)"
+            )
         self.execution_journal: Optional[ExecutionJournal] = None
         self._user_task_journal: Optional[Journal] = None
+        jkw = dict(
+            max_segment_records=cfg.get("journal.max.segment.records"),
+            fsync=cfg.get("journal.fsync"),
+        )
         if jdir:
             jdir = os.path.expanduser(jdir)
-            jkw = dict(
-                max_segment_records=cfg.get("journal.max.segment.records"),
-                fsync=cfg.get("journal.fsync"),
-            )
+        if jdir and self.replication_role == "writer":
             self.execution_journal = ExecutionJournal(
                 Journal(os.path.join(jdir, "executor"), **jkw)
             )
@@ -303,7 +314,7 @@ class CruiseControlTpuApp:
         # triggered incremental rebalancing with a durable standing proposal
         # set (journal.dir namespace <dir>/controller)
         self.controller = None
-        if cfg.get("controller.enable"):
+        if cfg.get("controller.enable") and self.replication_role == "writer":
             from cruise_control_tpu.controller import (
                 ContinuousController,
                 ControllerConfig,
@@ -328,6 +339,33 @@ class CruiseControlTpuApp:
                 ),
             )
             self.monitor.add_window_listener(self.controller.on_window_delta)
+
+        # replicated read plane (replication/): with a controller WAL on
+        # disk, every process carries a ReplicationState — the writer feeds
+        # it through the journal's append listener (same records, same
+        # order as the WAL), a follower through the tailer thread below —
+        # and the API stamps every read with {setVersion, epoch,
+        # stalenessMs, degraded}
+        self._replication = None
+        self._follower_tailer = None
+        if jdir:
+            from cruise_control_tpu.replication import (
+                FollowerTailer,
+                ReplicationState,
+            )
+
+            if self.replication_role == "follower":
+                self._replication = ReplicationState(writer=False)
+                self._follower_tailer = FollowerTailer(
+                    os.path.join(jdir, "controller"),
+                    self._replication,
+                    poll_interval_s=(
+                        cfg.get("replication.poll.interval.ms") / 1000.0
+                    ),
+                )
+            elif self.controller is not None and self.controller.journal is not None:
+                self._replication = ReplicationState(writer=True)
+                self.controller.journal.listener = self._replication.apply
 
         interval = cfg.get("anomaly.detection.interval.ms") / 1000.0
 
@@ -435,6 +473,12 @@ class CruiseControlTpuApp:
             # plane: the task table cap and the admission slot count now both
             # come from the one knob
             max_active_user_tasks=cfg.get("max.active.user.tasks"),
+            replication=self._replication,
+            replication_opts={
+                "lag.bound.ms": cfg.get("replication.lag.bound.ms"),
+                "degraded.after.ms": cfg.get("replication.degraded.after.ms"),
+                "watch.max.wait.ms": cfg.get("replication.watch.max.wait.ms"),
+            },
         )
         self._server = None
         self._sampling_thread: Optional[threading.Thread] = None
@@ -488,6 +532,35 @@ class CruiseControlTpuApp:
             except Exception as e:
                 if recovery_error is None:
                     recovery_error = f"{type(e).__name__}: {e}"
+            if (
+                self._replication is not None
+                and self.controller.standing is not None
+                and self._replication.set_version == 0
+            ):
+                # seed the writer's replicated view with the recovered set:
+                # the journal listener only sees appends made from now on
+                # (the startup rewrite feeds it when compaction ran; this
+                # covers the already-compact WAL)
+                s = self.controller.standing
+                from cruise_control_tpu.executor.journal import proposal_to_record
+
+                self._replication.apply({
+                    "type": "published", "version": s.version,
+                    "created_ms": s.created_ms, "trigger": s.trigger,
+                    "drift": s.drift, "reaction_s": s.reaction_s,
+                    "epoch": s.epoch,
+                    "proposals": [proposal_to_record(p) for p in s.proposals],
+                })
+        if self._follower_tailer is not None:
+            # the follower's recovery phase IS the first tail catch-up: one
+            # synchronous poll so reads answer from the journaled set the
+            # moment the ladder opens, then the background cadence takes over
+            try:
+                controller_records = self._follower_tailer.poll_once()
+            except Exception as e:
+                if recovery_error is None:
+                    recovery_error = f"{type(e).__name__}: {e}"
+            self._follower_tailer.start()
         wall = time.monotonic() - t_rec
         stats = self.executor.last_recovery_stats
         records = (
@@ -508,7 +581,10 @@ class CruiseControlTpuApp:
         self.readiness.set_phase(ReadinessState.MONITOR_WARMING)
 
         self.cruise_control.start()
-        self.anomaly_manager.start_detection()
+        if self.replication_role == "writer":
+            # followers serve reads — they never run detectors (whose
+            # passes can solve) or fix anything; one writer owns reaction
+            self.anomaly_manager.start_detection()
         interval_s = self.config.get("metric.sampling.interval.ms") / 1000.0
 
         if self._demo_backend and self.config.get("demo.bootstrap.on.start"):
@@ -533,10 +609,14 @@ class CruiseControlTpuApp:
             # the loop thread wakes on window deltas (and on cadence); it
             # warm-starts itself lazily once the monitor has a stable window
             self.controller.start()
-        self.app.start_proposal_refresher()
+        if self.replication_role == "writer":
+            # the precompute refresher runs the solver — not follower work
+            self.app.start_proposal_refresher()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._follower_tailer is not None:
+            self._follower_tailer.stop()
         if self.controller is not None:
             self.controller.stop()   # seals the controller journal
         self.app.stop_proposal_refresher()
@@ -562,6 +642,8 @@ class CruiseControlTpuApp:
         their periodic optimizes dispatch (and, after a jit-cache clear,
         recompile) inside unrelated flight-record windows."""
         self._stop.set()
+        if self._follower_tailer is not None:
+            self._follower_tailer.stop()
         if self.controller is not None:
             self.controller.kill()   # loop thread down, journal un-sealed
         self.app.stop_proposal_refresher()

@@ -30,6 +30,24 @@ Supported fault shapes (the ISSUE-2 chaos matrix):
   ``Journal.crash_after_appends``, recovery tests pin the process death at an
   exact backend call / journal append.
 
+Journal-level fault shapes (the replication-plane chaos matrix), applied by
+:class:`ChaosJournal` — a :class:`~cruise_control_tpu.core.journal.Journal`
+whose *write path* dies at plan-scripted points, leaving the exact on-disk
+wreckage each crash shape implies (recovery and WAL-tailing followers must
+digest the wreck, not just the exception):
+
+* ``torn_tail(after_appends)`` — the next append past the threshold writes
+  only a *prefix* of its record (torn mid-record, no newline) and dies: the
+  classic power-cut tail that replay's prefix tolerance and the tail cursor's
+  park-before-torn-line rule both must absorb.
+* ``lose_fsync_suffix(after_appends, lose)`` — the process dies and the last
+  ``lose`` appended records *vanish from disk* (the OS never flushed them):
+  what an un-fsynced page-cache suffix looks like after the machine dies.
+* ``rotation_crash(rotation_no)`` — the *n*-th rotation flushes, fsyncs and
+  closes the full segment but dies **before** the atomic rename: a complete
+  segment stranded under its ``.open`` name, the race window every reader's
+  sealed-name fallback exists for.
+
 Injected errors are :class:`ChaosInjectedError`, a ``ConnectionError``
 subclass, so the default :class:`~cruise_control_tpu.core.retry.RetryPolicy`
 classifies them as retryable.  Every injected fault is appended to
@@ -40,6 +58,8 @@ sensor, so tests and the STATE endpoint can assert exactly what chaos ran.
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import random
 import threading
 import time
@@ -54,10 +74,16 @@ from cruise_control_tpu.backend.base import (
     ReassignmentInProgress,
     TopicPartition,
 )
-from cruise_control_tpu.core.journal import SimulatedCrash
+from cruise_control_tpu.core.journal import Journal, SimulatedCrash, _canonical, _crc
 from cruise_control_tpu.core.sensors import CHAOS_FAULTS_COUNTER, REGISTRY
 
-__all__ = ["ChaosBackend", "ChaosInjectedError", "FaultPlan", "SimulatedCrash"]
+__all__ = [
+    "ChaosBackend",
+    "ChaosInjectedError",
+    "ChaosJournal",
+    "FaultPlan",
+    "SimulatedCrash",
+]
 
 
 class ChaosInjectedError(ConnectionError):
@@ -94,6 +120,13 @@ class FaultPlan:
         self.metric_gaps: List[Tuple[int, int]] = []  # [start, end) fetch calls
         #: method -> call count after which every call raises SimulatedCrash
         self.crash_points: Dict[str, int] = {}
+        # -- journal fault shapes (applied by ChaosJournal) --
+        #: appends after which the next one writes a torn prefix and dies
+        self.journal_torn_tail_after: Optional[int] = None
+        #: (after_appends, lose): die with the last ``lose`` records unflushed
+        self.journal_lost_suffix: Optional[Tuple[int, int]] = None
+        #: 1-based rotation number that dies between close and rename
+        self.journal_rotation_crash: Optional[int] = None
 
     # -- error rules --------------------------------------------------------
 
@@ -146,6 +179,120 @@ class FaultPlan:
         matches every method (total southbound blackout)."""
         self.crash_points[method] = n_calls
         return self
+
+    # -- journal faults (consumed by ChaosJournal) ---------------------------
+
+    def torn_tail(self, after_appends: int) -> "FaultPlan":
+        """The append after the first ``after_appends`` writes a torn prefix
+        of its record (no newline) and raises :class:`SimulatedCrash`."""
+        self.journal_torn_tail_after = after_appends
+        return self
+
+    def lose_fsync_suffix(self, after_appends: int, lose: int = 1) -> "FaultPlan":
+        """After ``after_appends`` appends the process dies and the last
+        ``lose`` records never reach disk (page-cache suffix lost)."""
+        self.journal_lost_suffix = (after_appends, lose)
+        return self
+
+    def rotation_crash(self, rotation_no: int = 1) -> "FaultPlan":
+        """The ``rotation_no``-th segment rotation dies after flush + close
+        but *before* the atomic rename: the complete segment is stranded
+        under its ``.open`` name."""
+        self.journal_rotation_crash = rotation_no
+        return self
+
+
+class ChaosJournal(Journal):
+    """A :class:`Journal` whose write path dies at the plan's scripted fault
+    points, leaving the on-disk wreckage the module docstring describes.
+
+    Every fault raises :class:`SimulatedCrash` — the test then recovers with
+    a *fresh* plain ``Journal`` (or tails the directory from another cursor),
+    exactly like a restarted process would.  Faults are logged to
+    ``fault_log`` and ticked on the chaos sensor, mirroring
+    :class:`ChaosBackend`'s accounting."""
+
+    def __init__(
+        self, directory: str, plan: Optional[FaultPlan] = None, **kwargs
+    ) -> None:
+        self.plan = plan or FaultPlan()
+        #: (fault kind, appends-or-rotations count when it fired)
+        self.fault_log: List[Tuple[str, int]] = []
+        #: rotations attempted by this writer (rotation_crash bookkeeping)
+        self.rotations = 0
+        super().__init__(directory, **kwargs)
+
+    def _record_fault(self, kind: str, at: int) -> None:
+        self.fault_log.append((kind, at))
+        REGISTRY.counter(CHAOS_FAULTS_COUNTER).inc()
+
+    def _append_locked(self, record: dict) -> None:
+        plan = self.plan
+        if (
+            plan.journal_torn_tail_after is not None
+            and self.appends >= plan.journal_torn_tail_after
+        ):
+            # write a prefix of the encoded line — torn mid-record, no
+            # newline — flush it so the wreck is visible, then die
+            payload = _canonical(record)
+            line = json.dumps(
+                {"c": _crc(payload), "r": record},
+                separators=(",", ":"),
+                default=str,
+            )
+            if self._fh is None:
+                self._fh = open(self._path(self._segment_idx, True), "a")
+                self._records_in_segment = 0
+            self._fh.write(line[: max(1, len(line) // 2)])
+            self._fh.flush()
+            self._record_fault("torn_tail", self.appends)
+            raise SimulatedCrash(
+                f"journal torn-tail fault after {self.appends} append(s)"
+            )
+        if (
+            plan.journal_lost_suffix is not None
+            and self.appends >= plan.journal_lost_suffix[0]
+        ):
+            lose = plan.journal_lost_suffix[1]
+            # the process dies; the OS never flushed the last `lose` lines —
+            # emulated by truncating them back out of the .open segment
+            if self._fh is not None:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
+            path = self._path(self._segment_idx, True)
+            try:
+                with open(path, "rb") as fh:
+                    lines = fh.read().splitlines(keepends=True)
+                with open(path, "wb") as fh:
+                    fh.writelines(lines[: max(0, len(lines) - lose)])
+            except FileNotFoundError:
+                pass
+            self._record_fault("fsync_lost_suffix", self.appends)
+            raise SimulatedCrash(
+                f"journal fsync-lost fault: last {lose} record(s) lost "
+                f"after {self.appends} append(s)"
+            )
+        super()._append_locked(record)
+
+    def _rotate_locked(self) -> None:
+        self.rotations += 1
+        if (
+            self.plan.journal_rotation_crash is not None
+            and self.rotations >= self.plan.journal_rotation_crash
+        ):
+            # seal-worthy segment: flush, fsync, close — then die before the
+            # rename, stranding the complete segment under its .open name
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._fh.close()
+                self._fh = None
+            self._record_fault("rotation_crash", self.rotations)
+            raise SimulatedCrash(
+                f"journal rotation-race fault at rotation #{self.rotations}"
+            )
+        super()._rotate_locked()
 
 
 class ChaosBackend(ClusterBackend):

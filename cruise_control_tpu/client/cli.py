@@ -55,6 +55,19 @@ def build_parser() -> argparse.ArgumentParser:
     ct.add_argument("--reason", default="cctpu",
                     help="operator note recorded with pause/resume")
 
+    wt = sub.add_parser(
+        "watch",
+        help="standing-proposal-set deltas via long-poll (GET /watch): "
+             "published/superseded/drained events keyed by version, instead "
+             "of polling user_tasks",
+    )
+    wt.add_argument("--since", type=int, default=0,
+                    help="delta cursor: last seq already seen (default 0)")
+    wt.add_argument("--timeout-ms", type=int, default=30_000,
+                    help="long-poll park time per request (server-capped)")
+    wt.add_argument("--follow", action="store_true",
+                    help="re-arm forever, printing one JSON delta per line")
+
     tr = sub.add_parser(
         "traces",
         help="flight-recorder records (GET), or — with --traces-json and "
@@ -178,6 +191,14 @@ def main(argv=None) -> int:
                 out = client.controller_resume(reason=args.reason)
             else:
                 out = client.controller_tick()
+        elif ep == "watch":
+            if args.follow:
+                for delta in client.watch_iter(
+                    since=args.since, timeout_ms=args.timeout_ms
+                ):
+                    print(json.dumps(delta))
+                return 0
+            out = client.watch(since=args.since, timeout_ms=args.timeout_ms)
         elif ep == "traces":
             if args.traces_json or args.policies_json:
                 if not (args.traces_json and args.policies_json):

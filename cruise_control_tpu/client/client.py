@@ -215,6 +215,23 @@ class CruiseControlClient:
         evaluation instead of waiting for drift/cadence."""
         return self._post("controller", action="tick")
 
+    def watch(self, since: int = 0, timeout_ms: int = 0) -> Any:
+        """GET /watch: long-poll standing-proposal-set deltas (published /
+        superseded / drained / epoch, keyed by version) since the ``since``
+        cursor.  Re-arm with the returned ``since``; ``resync=true`` means
+        the cursor fell off the delta ring and the single delta is a
+        snapshot of the current set."""
+        return self._get("watch", since=since, timeout_ms=timeout_ms)
+
+    def watch_iter(self, since: int = 0, timeout_ms: int = 30_000):
+        """Generator of deltas, re-arming the long-poll forever — the
+        replacement for a USER_TASKS polling loop."""
+        while True:
+            page = self.watch(since=since, timeout_ms=timeout_ms)
+            for delta in page.get("deltas", []):
+                yield delta
+            since = page.get("since", since)
+
     def healthz(self, readiness: bool = False) -> Any:
         """GET /healthz: liveness + the startup readiness ladder
         (``recovering`` → ``monitor_warming`` → ``ready``).  With

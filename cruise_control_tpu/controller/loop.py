@@ -47,7 +47,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -58,6 +58,7 @@ from cruise_control_tpu.analyzer.proposals import diff as diff_proposals
 from cruise_control_tpu.controller.drift import DriftReport, evaluate_drift
 from cruise_control_tpu.controller.standing import (
     ControllerJournal,
+    FencedEpochError,
     StandingProposalSet,
 )
 from cruise_control_tpu.core.resources import Resource
@@ -135,6 +136,11 @@ class ContinuousController:
 
         self.standing: Optional[StandingProposalSet] = None
         self._version = 0
+        #: chaos seam for the replication failover drill: invoked right
+        #: after the journal write-ahead succeeds and BEFORE the in-memory
+        #: swap — the exact window where a dying writer leaves followers
+        #: holding a set the writer itself never served
+        self._hook_after_journal_publish: Optional[Callable[[], None]] = None
 
         self.paused = False
         self.pause_reason: Optional[str] = None
@@ -229,11 +235,12 @@ class ContinuousController:
             CONTROLLER_STANDING_PROPOSALS_GAUGE,
             CONTROLLER_STANDING_VERSION_GAUGE,
             REGISTRY,
+            REPLICATION_EPOCH_GAUGE,
         )
 
         if self.journal is None:
             return 0
-        standing, max_version, records = self.journal.recover()
+        standing, max_version, records, epoch = self.journal.recover()
         self.standing = standing
         self._version = max(self._version, max_version)
         if records > 1:
@@ -244,6 +251,20 @@ class ContinuousController:
                 self.journal.rewrite(standing)
             except Exception:
                 pass
+        # claim the write path: epoch + 1 fences every older holder,
+        # including this process's own previous incarnation — restart and
+        # follower promotion are the same move (see standing.py docstring).
+        # After the rewrite, so the journaled epoch record survives the
+        # compaction and tailing followers learn the regime change.  A
+        # refused fence (a newer holder already fenced) leaves this process
+        # a read-only stale writer: every later append is refused too.
+        try:
+            self.journal.fence(epoch + 1)
+        except FencedEpochError:
+            pass
+        except Exception:
+            pass
+        REGISTRY.gauge(REPLICATION_EPOCH_GAUGE).set(self.journal.epoch)
         if standing is not None:
             REGISTRY.gauge(CONTROLLER_STANDING_VERSION_GAUGE).set(standing.version)
             REGISTRY.gauge(CONTROLLER_STANDING_PROPOSALS_GAUGE).set(
@@ -634,9 +655,12 @@ class ContinuousController:
             try:
                 if self.journal is not None:
                     # write-ahead of the in-memory swap: a refused append
-                    # (full disk, simulated crash) leaves the OLD set
-                    # standing — memory and journal never diverge
+                    # (full disk, simulated crash, a newer fenced epoch)
+                    # leaves the OLD set standing — memory and journal
+                    # never diverge
                     self.journal.published(candidate)
+                if self._hook_after_journal_publish is not None:
+                    self._hook_after_journal_publish()
                 superseded = self.standing
                 self.standing = candidate
                 self._version = candidate.version
@@ -797,6 +821,9 @@ class ContinuousController:
             # loop is flying blind (e.g. a reporter-feed outage) — it stops
             # reacting but the standing set stays intact (no thrash)
             "stale": staleness > self.cfg.stale_after_s,
+            # writer epoch: which fenced regime this process mutates under
+            # (0 = no journal / never fenced)
+            "epoch": self.journal.epoch if self.journal is not None else 0,
             "drift": drift.score if drift else 0.0,
             "balancedness": drift.balancedness if drift else None,
             "violatedGoals": drift.violated_goals if drift else [],

@@ -15,7 +15,13 @@ absent — the backend SPI replaces them.
 
 from __future__ import annotations
 
-from cruise_control_tpu.core.config import ConfigDef, Importance, Type, in_range
+from cruise_control_tpu.core.config import (
+    ConfigDef,
+    Importance,
+    Type,
+    in_range,
+    in_values,
+)
 
 H, M, L = Importance.HIGH, Importance.MEDIUM, Importance.LOW
 
@@ -377,6 +383,39 @@ def webserver_config() -> ConfigDef:
     return d
 
 
+def replication_config() -> ConfigDef:
+    """Replicated read plane (replication/ — TPU-specific, no reference
+    counterpart): WAL-tailing follower processes, writer epoch fencing, and
+    long-poll watch subscriptions over the standing proposal set."""
+    d = ConfigDef()
+    d.define("replication.role", Type.STRING, "writer", H,
+             "Process role.  'writer' (default) owns optimize/execute and "
+             "the controller WAL write path.  'follower' tails the writer's "
+             "journal.dir read-only, serves the read surface + WATCH, and "
+             "refuses every mutating endpoint — promote one by restarting "
+             "it as a writer on the same journal.dir (it fences the old "
+             "writer's epoch).", in_values("writer", "follower"))
+    d.define("replication.poll.interval.ms", Type.LONG, 50, M,
+             "Follower WAL-tail poll cadence.  Lower = fresher reads and "
+             "faster watch delta fan-out, at more filesystem stats.",
+             in_range(lo=1))
+    d.define("replication.lag.bound.ms", Type.LONG, 5_000, H,
+             "Staleness budget: a follower whose last successful tail poll "
+             "is older than this answers 503 + Retry-After instead of "
+             "silently-stale data (the PR 8 shed discipline applied to "
+             "replication lag).", in_range(lo=1))
+    d.define("replication.degraded.after.ms", Type.LONG, 10_000, M,
+             "With no writer WAL activity for this long, follower reads are "
+             "stamped degraded=true — still served (the journaled set is "
+             "authoritative) but flagged so clients know the writer may be "
+             "down.", in_range(lo=1))
+    d.define("replication.watch.max.wait.ms", Type.LONG, 30_000, L,
+             "Ceiling on a WATCH long-poll's timeout_ms parameter; a poll "
+             "with no delta by then returns an empty page (clients just "
+             "re-arm with the same cursor).", in_range(lo=1))
+    return d
+
+
 def cruise_control_config() -> ConfigDef:
     """The merged registry (KafkaCruiseControlConfig)."""
     d = ConfigDef()
@@ -388,6 +427,7 @@ def cruise_control_config() -> ConfigDef:
         admission_config(),
         anomaly_detector_config(),
         webserver_config(),
+        replication_config(),
     ):
         d.merge(group)
     return d

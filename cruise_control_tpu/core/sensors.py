@@ -253,6 +253,15 @@ DETECTOR_BREAKER_SKIPS_COUNTER = "AnomalyDetector.passes-skipped-breaker-open"
 # window-listener failures (monitor/loadmonitor.py _notify_windows) — a
 # listener raising must never break ingest, but it must not vanish either
 MONITOR_LISTENER_ERRORS_COUNTER = "LoadMonitor.listener-errors"
+# replication plane (replication/, controller/standing.py fencing)
+REPLICATION_EPOCH_GAUGE = "Replication.writer-epoch"
+REPLICATION_FENCE_REFUSALS_COUNTER = "Replication.fence-refusals"
+REPLICATION_STALENESS_GAUGE = "Replication.follower-staleness-ms"
+REPLICATION_APPLIED_COUNTER = "Replication.records-applied"
+REPLICATION_WATCHERS_GAUGE = "Replication.watchers"
+REPLICATION_DELTAS_COUNTER = "Replication.deltas-published"
+REPLICATION_STALE_503_COUNTER = "Replication.lag-bound-503s"
+REPLICATION_RESETS_COUNTER = "Replication.tail-resets"
 # time-series scenario engine (traces/)
 TRACE_ROLLOUTS_COUNTER = "TraceEngine.rollouts"
 TRACE_PAIRS_COUNTER = "TraceEngine.pairs-evaluated"

@@ -318,6 +318,43 @@ def _run_serving_tier(inject_sleep_s: float = 0.0) -> dict:
     }
 
 
+def _run_replication_tier(inject_sleep_s: float = 0.0) -> dict:
+    """Replicated-read-plane tier: delta-propagation p95 + the fan-out
+    contract.
+
+    Runs the SAME harness that commits ``benchmarks/BENCH_REPLICATION_cpu.
+    json`` (``cruise_control_tpu/replication/bench.py``): a fenced writer
+    appending published standing sets, ≥2 real follower processes tailing
+    the WAL, hundreds of concurrent long-poll watchers.  The contract
+    violations — any 5xx on the watch path, any watcher-observed version
+    regression, incomplete delivery, fewer than 2 follower processes — are
+    hard errors; the p95 writer-append → watcher-receipt propagation is the
+    gated wall (>25 % vs the committed artifact fails, see
+    ``_replication_baseline``)."""
+    _force_cpu_platform()
+    from cruise_control_tpu.replication import bench
+
+    m = bench.run_bench()
+    contract = bench.check_contract(m)
+    if contract:
+        return {"tier": "replication", "error": "; ".join(contract)}
+    wall = m["p95_propagation_s"]
+    if inject_sleep_s:
+        time.sleep(inject_sleep_s)
+        wall += inject_sleep_s
+    return {
+        "tier": "replication",
+        "platform": "cpu",
+        "wall_s": round(wall, 4),
+        "followers_serving": m["followers_serving"],
+        "watchers": m["workload"]["watchers"],
+        "deliveries": m["deliveries"],
+        "http_5xx": m["http_5xx"],
+        "version_regressions": m["version_regressions"],
+        "goodput_deliveries_per_s": m["goodput_deliveries_per_s"],
+    }
+
+
 def _run_traces_tier(inject_sleep_s: float = 0.0) -> dict:
     """Trace-engine tier: batched-rollout warm wall + the one-program budget.
 
@@ -543,6 +580,19 @@ def _traces_baseline(root: str) -> Optional[dict]:
     return {"wall_s": doc.get("warm_s")}
 
 
+def _replication_baseline(root: str) -> Optional[dict]:
+    """Gate baseline for the replication tier, derived from the committed
+    bench artifact (``benchmarks/BENCH_REPLICATION_cpu.json``) — same
+    single-source pattern as the controller/serving/traces tiers."""
+    path = os.path.join(root, "benchmarks", "BENCH_REPLICATION_cpu.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return {"wall_s": doc.get("p95_propagation_s")}
+
+
 def _controller_baseline(root: str) -> Optional[dict]:
     """Gate baseline for the controller tier, derived from the committed
     bench artifact (``benchmarks/BENCH_CONTROLLER_cpu.json``) — the ISSUE
@@ -588,11 +638,15 @@ TIERS: Dict[str, GateTier] = {
                  "vs BENCH_TRACES_cpu.json",
                  build=None, bench_comparable=False,
                  runner=_run_traces_tier),
+        GateTier("replication", "multi-process fan-out: delta-propagation "
+                 "p95 + watch contract vs BENCH_REPLICATION_cpu.json",
+                 build=None, bench_comparable=False,
+                 runner=_run_replication_tier),
     )
 }
 DEFAULT_TIERS = (
     "config1", "config2_small", "mesh8", "exporter", "controller", "serving",
-    "sharded", "traces",
+    "sharded", "traces", "replication",
 )
 
 
@@ -965,6 +1019,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"dispatches={m.get('warm_dispatches')} "
                 f"warm_compiles={m.get('warm_compile_events')}"
             )
+        elif "deliveries" in m:   # replication tier: fan-out propagation p95
+            status = (
+                f"p95_propagation={m['wall_s']}s "
+                f"deliveries={m.get('deliveries')} "
+                f"followers={m.get('followers_serving')} "
+                f"5xx={m.get('http_5xx')} "
+                f"regressions={m.get('version_regressions')}"
+            )
         elif "goodput_rps" in m:   # serving tier: admitted p95 + shed contract
             status = (
                 f"p95_admitted={m['wall_s']}s admitted={m.get('admitted')} "
@@ -1028,6 +1090,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             # and the traces tier against benchmarks/BENCH_TRACES_cpu.json
             # (scripts/bench_traces.py)
             base = _traces_baseline(root)
+        if base is None and m["tier"] == "replication":
+            # and the replication tier against BENCH_REPLICATION_cpu.json
+            # (scripts/bench_serving.py --replication)
+            base = _replication_baseline(root)
         if base is None:
             failures.append(
                 f"{m['tier']}: no committed gate baseline for this tier "

@@ -1,0 +1,17 @@
+"""Replicated read plane: WAL-tailing followers, writer fencing, watches.
+
+The standing proposal set (PR 7) is already a versioned, journaled,
+crash-recoverable value — this package makes it the *replication unit*.
+Follower processes tail the controller WAL with
+:meth:`~cruise_control_tpu.core.journal.Journal.tail`, fold the records into
+a :class:`~cruise_control_tpu.replication.state.ReplicationState`, and serve
+the full read surface plus long-poll WATCH subscriptions, while exactly one
+writer (fenced by epoch, :mod:`cruise_control_tpu.controller.standing`) owns
+optimize/execute.  Decisions are computed once and distributed to many cheap
+replicas — the "execution templates" shape at the serving tier.
+"""
+
+from cruise_control_tpu.replication.follower import FollowerTailer
+from cruise_control_tpu.replication.state import ReplicationState
+
+__all__ = ["FollowerTailer", "ReplicationState"]

@@ -15,9 +15,17 @@ clients, and enforces two kinds of verdicts against the committed
 
 A workload mismatch vs the baseline is an infrastructure error (exit 2).
 
+``--replication`` switches to the multi-process fan-out bench
+(``cruise_control_tpu/replication/bench.py``, the same harness the
+``replication`` gate tier runs): ≥2 real follower processes tailing a fenced
+writer's WAL, hundreds of concurrent long-poll watchers, gated on
+delta-propagation p95 vs ``benchmarks/BENCH_REPLICATION_cpu.json`` plus the
+hard contract — zero 5xx, zero version regressions, complete delivery.
+
     python scripts/bench_serving.py                     # run + gate
     python scripts/bench_serving.py --update-baseline   # regenerate baseline
     python scripts/bench_serving.py --clients 50        # quick smoke (no gate)
+    python scripts/bench_serving.py --replication       # fan-out bench + gate
 """
 
 from __future__ import annotations
@@ -32,12 +40,74 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from cruise_control_tpu.api import bench  # noqa: E402
 
-BASELINE = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "benchmarks", "BENCH_SERVING_cpu.json",
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(_ROOT, "benchmarks", "BENCH_SERVING_cpu.json")
+REPLICATION_BASELINE = os.path.join(
+    _ROOT, "benchmarks", "BENCH_REPLICATION_cpu.json"
 )
 MAX_WALL_RATIO = 1.25
 WALL_FLOOR_S = 0.25
+
+
+def _gate_replication(args) -> int:
+    """The --replication mode: fan-out bench + contract + p95 gate."""
+    from cruise_control_tpu.replication import bench as rbench
+
+    doc = rbench.run_bench(watchers=args.watchers)
+    print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+
+    contract = rbench.check_contract(doc)
+    if contract:
+        print("REPLICATION CONTRACT VIOLATED:", file=sys.stderr)
+        for c in contract:
+            print(f"  - {c}", file=sys.stderr)
+        return 1
+
+    if args.update_baseline:
+        with open(REPLICATION_BASELINE, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"baseline written: {REPLICATION_BASELINE}", file=sys.stderr)
+        return 0
+
+    if args.watchers != rbench.WATCHERS:
+        print("non-default workload: contract checked, baseline compare "
+              "skipped", file=sys.stderr)
+        return 0
+
+    if not os.path.exists(REPLICATION_BASELINE):
+        print(f"missing baseline {REPLICATION_BASELINE}; run "
+              "--replication --update-baseline", file=sys.stderr)
+        return 2
+    with open(REPLICATION_BASELINE) as f:
+        base = json.load(f)
+    if base.get("workload") != doc["workload"]:
+        print("workload mismatch vs baseline — regenerate with "
+              "--replication --update-baseline", file=sys.stderr)
+        return 2
+    slack = float(os.environ.get("CC_TPU_GATE_WALL_SLACK", "1.0"))
+    budget = base["p95_propagation_s"] * MAX_WALL_RATIO * slack + WALL_FLOOR_S
+    if doc["p95_propagation_s"] > budget:
+        print(
+            f"REPLICATION REGRESSION: p95 propagation "
+            f"{doc['p95_propagation_s']:.3f}s > budget {budget:.3f}s "
+            f"(baseline {base['p95_propagation_s']:.3f}s × {MAX_WALL_RATIO} "
+            f"× slack {slack} + {WALL_FLOOR_S}s floor)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"replication gate OK: p95 propagation "
+        f"{doc['p95_propagation_s']:.3f}s <= budget {budget:.3f}s; "
+        f"{doc['deliveries']} deliveries to {doc['workload']['watchers']} "
+        f"watchers across {doc['followers_serving']} follower processes, "
+        "0 × 5xx, 0 version regressions",
+        file=sys.stderr,
+    )
+    return 0
 
 
 def main(argv=None) -> int:
@@ -46,8 +116,20 @@ def main(argv=None) -> int:
     ap.add_argument("--clients", type=int, default=bench.CLIENTS,
                     help="concurrent REST clients (non-default skips the "
                          "baseline compare — the workload differs)")
+    ap.add_argument("--replication", action="store_true",
+                    help="run the multi-process replication fan-out bench "
+                         "instead of the single-process overload bench")
+    ap.add_argument("--watchers", type=int, default=None,
+                    help="(--replication) concurrent watchers; non-default "
+                         "skips the baseline compare")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+
+    if args.replication:
+        from cruise_control_tpu.replication import bench as rbench
+        if args.watchers is None:
+            args.watchers = rbench.WATCHERS
+        return _gate_replication(args)
 
     doc = bench.run_bench(clients=args.clients)
     print(json.dumps(doc, indent=2))

@@ -55,6 +55,15 @@ python -m pytest tests/test_traces.py -x -q
 echo "== traces bench (16-pair x 64-step rollout: warm wall + 1-dispatch/0-compile vs committed baseline) =="
 python scripts/bench_traces.py >/dev/null
 
+echo "== replication tier (WAL tailing, epoch fencing, watch hub, follower serving) =="
+python -m pytest tests/test_replication.py -x -q -m "not slow"
+
+echo "== replication bench (500 watchers x 2 follower processes: propagation-p95 + zero-5xx/zero-regression contract) =="
+python scripts/bench_serving.py --replication >/dev/null
+
+echo "== replication drill (writer chaos-killed mid-publish under open watches; multi-process, marked slow) =="
+python -m pytest tests/test_replication_drill.py -x -q
+
 echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check; incl. the sharded tier vs BENCH_SHARDED_8dev_virtual.json) =="
 python scripts/bench_gate.py
 

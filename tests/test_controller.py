@@ -199,7 +199,7 @@ class TestStandingJournal:
         j.published(self._set(1))
         j.published(self._set(2, n=3))
         j.invalidated(1, "superseded")
-        standing, max_v, records = ControllerJournal(
+        standing, max_v, records, _ = ControllerJournal(
             Journal(str(tmp_path / "controller"))
         ).recover()
         assert standing.version == 2 and len(standing.proposals) == 3
@@ -208,7 +208,7 @@ class TestStandingJournal:
         # drained ⇒ nothing standing, journal compacted
         j2 = ControllerJournal(Journal(str(tmp_path / "controller")))
         j2.drained(2)
-        standing3, max_v3, _ = ControllerJournal(
+        standing3, max_v3, _, _ = ControllerJournal(
             Journal(str(tmp_path / "controller"))
         ).recover()
         assert standing3 is None and max_v3 == 0   # truncate wiped history
@@ -219,7 +219,7 @@ class TestStandingJournal:
         j.published(self._set(2))
         # crash before the invalidate record: replay still supersedes
         # implicitly (newest published version wins)
-        standing, _, _ = ControllerJournal(
+        standing, _, _, _ = ControllerJournal(
             Journal(str(tmp_path / "controller"))
         ).recover()
         assert standing.version == 2
@@ -237,7 +237,7 @@ class TestStandingJournal:
         j2 = ControllerJournal(Journal(str(tmp_path / "controller")))
         records = j2.journal.replay()
         assert len(records) == 1 and records[0]["version"] == 5
-        standing, _, _ = j2.recover()
+        standing, _, _, _ = j2.recover()
         assert standing.version == 5 and len(standing.proposals) == 3
 
     def test_recover_compacts_superseded_history(self, tmp_path):
@@ -260,9 +260,11 @@ class TestStandingJournal:
         )
         assert controller.recover() == 3
         assert controller.standing.version == 3
-        # the startup rewrite left exactly the live set behind
+        # the startup rewrite left the live set + the fence's epoch record
         replayed = Journal(str(tmp_path / "controller")).replay()
-        assert len(replayed) == 1 and replayed[0]["version"] == 3
+        published = [r for r in replayed if r["type"] == "published"]
+        assert len(published) == 1 and published[0]["version"] == 3
+        assert [r for r in replayed if r["type"] == "epoch"]
 
     def test_refused_publish_append_raises(self, tmp_path):
         j = self._journal(tmp_path)
@@ -272,7 +274,7 @@ class TestStandingJournal:
             j.published(self._set(2))
         # the WAL still holds (only) version 1 — write-ahead means the
         # in-memory swap never happened either (loop.py catches and keeps v1)
-        standing, _, _ = ControllerJournal(
+        standing, _, _, _ = ControllerJournal(
             Journal(str(tmp_path / "controller"))
         ).recover()
         assert standing.version == 1
@@ -563,7 +565,7 @@ class TestExecutorDrain:
         # executed and drained: nothing standing, journal compacted,
         # the backend actually moved the replicas
         assert controller.standing is None
-        standing, _, _ = ControllerJournal(
+        standing, _, _, _ = ControllerJournal(
             Journal(str(tmp_path / "controller"))
         ).recover()
         assert standing is None

@@ -525,6 +525,7 @@ class TestGateEndToEnd:
             "controller": gate_mod._controller_baseline,
             "serving": gate_mod._serving_baseline,
             "traces": gate_mod._traces_baseline,
+            "replication": gate_mod._replication_baseline,
         }
         for tier in gate_mod.DEFAULT_TIERS:
             if tier in artifact_baselines and tier not in doc["tiers"]:

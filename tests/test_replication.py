@@ -1,0 +1,701 @@
+"""Replicated read plane tier (ISSUE 17).
+
+Covers the four layers the replication package stitches together:
+
+* ``Journal.tail()`` / :class:`JournalTail` — the live WAL cursor followers
+  poll: rotation rename races, torn ``.open`` tails (park, never skip, until
+  the segment seals), writer ``truncate()`` detection by segment identity,
+  and the exactly-once contract under a concurrent writer;
+* epoch fencing (``controller/standing.py``) — the sidecar re-read refusal
+  point: a promoted follower's ``fence(epoch+1)`` makes the deposed writer's
+  next append raise :class:`FencedEpochError` *before* the WAL sees a
+  stale-regime record, restarts re-fence cleanly, and ``recover()`` surfaces
+  the newest epoch from sidecar + journaled stamps;
+* :class:`ReplicationState` — the watch hub: idempotent record application,
+  cursor catch-up / ring-falloff resync, long-poll wakeups, and the
+  ``rebase()`` reconciliation after a tail reset;
+* follower serving over real HTTP — stamped reads, refused mutations,
+  long-poll WATCH delivery from writer append to follower watcher, and the
+  lag-bound 503 with its derived Retry-After (liveness stays exempt).
+
+Journal-level fault injection (``FaultPlan.torn_tail`` /
+``lose_fsync_suffix`` / ``rotation_crash`` via :class:`ChaosJournal`) runs
+under the ``chaos`` marker — deterministic, part of tier-1.  The
+multi-process failover drill lives in ``tests/test_replication_drill.py``
+(marked ``slow``, run by name in its own CI step).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.backend.chaos import ChaosJournal, FaultPlan
+from cruise_control_tpu.controller.standing import (
+    ControllerJournal,
+    FencedEpochError,
+    StandingProposalSet,
+)
+from cruise_control_tpu.core.journal import (
+    Journal,
+    JournalTail,
+    SimulatedCrash,
+    _canonical,
+    _crc,
+)
+from cruise_control_tpu.replication import ReplicationState
+
+WINDOW_MS = 60_000
+TRIMMED_GOALS = "RackAwareGoal,ReplicaCapacityGoal,ReplicaDistributionGoal"
+
+
+def ids(records):
+    return [r["i"] for r in records]
+
+
+def encode_line(record: dict) -> str:
+    """The exact on-disk envelope ``Journal.append`` writes (used to craft
+    torn tails byte-for-byte)."""
+    return json.dumps(
+        {"c": _crc(_canonical(record)), "r": record}, separators=(",", ":")
+    )
+
+
+def some_proposals(n: int = 2):
+    return [
+        ExecutionProposal(
+            tp=("T", i), partition_size=1.0, old_leader=0,
+            old_replicas=(0, 1), new_replicas=(0, 2),
+        )
+        for i in range(n)
+    ]
+
+
+def standing_set(version: int, trigger: str = "drift") -> StandingProposalSet:
+    return StandingProposalSet(
+        version=version, created_ms=123, trigger=trigger, drift=2.0,
+        proposals=some_proposals(), reaction_s=0.01,
+    )
+
+
+def published_record(version: int, epoch: int = 1, **extra) -> dict:
+    rec = {
+        "type": "published", "version": version, "epoch": epoch,
+        "created_ms": 123, "trigger": "drift", "drift": 2.0,
+        "reaction_s": 0.01, "proposals": [],
+    }
+    rec.update(extra)
+    return rec
+
+
+# -- the live WAL cursor ------------------------------------------------------
+
+
+class TestJournalTail:
+    def test_poll_returns_appends_in_order_and_catches_up(self, tmp_path):
+        j = Journal(str(tmp_path), max_segment_records=4)
+        for i in range(10):
+            j.append({"i": i})
+        t = j.tail()
+        assert ids(t.poll()) == list(range(10))
+        assert t.caught_up is True and t.records == 10
+        assert t.poll() == []          # nothing new: still caught up
+        j.append({"i": 10})
+        assert ids(t.poll()) == [10]
+        assert t.resets == 0 and t.skipped == 0
+
+    def test_max_records_paginates_without_loss(self, tmp_path):
+        j = Journal(str(tmp_path), max_segment_records=3)
+        for i in range(8):
+            j.append({"i": i})
+        t = j.tail()
+        got = []
+        while True:
+            page = t.poll(max_records=3)
+            if not page:
+                break
+            assert len(page) <= 3
+            got.extend(ids(page))
+        assert got == list(range(8))
+
+    def test_rotation_rename_race_resumes_under_sealed_name(self, tmp_path):
+        """Cursor parked mid-``.open`` segment; the writer seals it (atomic
+        rename, same inode); the next poll continues at the same byte offset
+        under the sealed name — no miss, no double-delivery."""
+        j = Journal(str(tmp_path), max_segment_records=3)
+        j.append({"i": 0})
+        j.append({"i": 1})
+        t = j.tail()
+        assert ids(t.poll()) == [0, 1]   # cursor now mid segment-000000.open
+        j.append({"i": 2})               # fills the segment: rotation seals it
+        j.append({"i": 3})               # lands in segment-000001.open
+        assert os.path.exists(str(tmp_path / "segment-000000.jsonl"))
+        assert ids(t.poll()) == [2, 3]
+        assert t.resets == 0 and t.skipped == 0
+
+    def test_torn_open_tail_parks_then_completes(self, tmp_path):
+        """A torn (half-written) record at the end of the ``.open`` segment
+        is a write in progress, not corruption: the cursor parks before it
+        and delivers it whole once the writer finishes the line."""
+        j = Journal(str(tmp_path))
+        j.append({"i": 0})
+        j.append({"i": 1})
+        t = j.tail()
+        assert ids(t.poll()) == [0, 1]
+        line = encode_line({"i": 2})
+        open_seg = str(tmp_path / "segment-000000.jsonl.open")
+        with open(open_seg, "a") as fh:
+            fh.write(line[: len(line) // 2])   # torn: no newline
+        assert t.poll() == []
+        assert t.skipped == 0                  # parked, NOT skipped
+        with open(open_seg, "a") as fh:
+            fh.write(line[len(line) // 2:] + "\n")
+        assert ids(t.poll()) == [2]
+        assert t.skipped == 0 and t.resets == 0
+
+    def test_sealed_torn_tail_is_permanently_skipped(self, tmp_path):
+        """Once a crashed writer's torn tail is sealed into a final segment
+        (restart recovery), it can never complete: the cursor skips it for
+        good and the WAL keeps flowing."""
+        j = Journal(str(tmp_path))
+        for i in range(3):
+            j.append({"i": i})
+        line = encode_line({"i": 99})
+        with open(str(tmp_path / "segment-000000.jsonl.open"), "a") as fh:
+            fh.write(line[: len(line) // 2])
+        # restart: a fresh writer seals the leftover .open (torn tail and all)
+        j2 = Journal(str(tmp_path))
+        assert os.path.exists(str(tmp_path / "segment-000000.jsonl"))
+        t = JournalTail(str(tmp_path))
+        assert ids(t.poll()) == [0, 1, 2]
+        assert t.skipped == 1                  # the torn line, permanently
+        j2.append({"i": 3})                    # next segment: not wedged
+        assert ids(t.poll()) == [3]
+        assert t.resets == 0
+
+    def test_truncate_resets_cursor_and_redelivers(self, tmp_path):
+        j = Journal(str(tmp_path), max_segment_records=3)
+        for i in range(5):
+            j.append({"i": i})
+        t = j.tail()
+        assert ids(t.poll()) == list(range(5))
+        j.truncate()                           # writer-side compaction
+        j.append({"i": 100})
+        j.append({"i": 101})
+        got = []
+        deadline = time.monotonic() + 5.0
+        while len(got) < 2 and time.monotonic() < deadline:
+            got.extend(ids(t.poll()))          # reset pass, then re-delivery
+        assert got == [100, 101]               # the new WAL regime, whole
+        assert t.resets == 1
+
+    def test_concurrent_writer_exactly_once_in_order(self, tmp_path):
+        """Satellite regression: a cursor polling concurrently with a writer
+        that rotates every 7 records must deliver every record exactly once,
+        in write order — the rotation rename race and the torn-flush window
+        are both crossed hundreds of times."""
+        n = 300
+        j = Journal(str(tmp_path), max_segment_records=7)
+        t = j.tail()
+        stop = threading.Event()
+
+        def writer():
+            for i in range(n):
+                j.append({"i": i})
+            stop.set()
+
+        thr = threading.Thread(target=writer)
+        thr.start()
+        got = []
+        deadline = time.monotonic() + 60.0
+        while len(got) < n and time.monotonic() < deadline:
+            got.extend(ids(t.poll()))
+        thr.join(timeout=30)
+        got.extend(ids(t.poll()))
+        assert got == list(range(n))
+        assert t.resets == 0 and t.skipped == 0
+
+    def test_replay_iter_survives_rotation_rename_race(self, tmp_path):
+        """Satellite fix: ``replay_iter`` captures the segment listing once;
+        a segment sealed between the listing and its ``open()`` is retried
+        under the final name (same inode, same bytes) — exactly once."""
+        j = Journal(str(tmp_path), max_segment_records=3)
+        for i in range(5):
+            j.append({"i": i})   # seg0 sealed [0,1,2]; seg1.open [3,4]
+        counts: dict = {}
+        it = j.replay_iter(counts)
+        first = next(it)         # listing captured: [seg0, seg1.jsonl.open]
+        assert first["i"] == 0
+        j.append({"i": 5})       # seals seg1 under the iterator's feet
+        assert not os.path.exists(str(tmp_path / "segment-000001.jsonl.open"))
+        rest = [r["i"] for r in it]
+        assert [first["i"]] + rest == [0, 1, 2, 3, 4, 5]
+        assert counts["skipped"] == 0 and counts["segments"] == 2
+
+
+# -- epoch fencing ------------------------------------------------------------
+
+
+class TestEpochFencing:
+    def _journal(self, tmp_path) -> ControllerJournal:
+        return ControllerJournal(Journal(str(tmp_path / "controller")))
+
+    def test_stale_epoch_append_refused_after_promotion(self, tmp_path):
+        """The deposed writer's next append dies at the sidecar re-read —
+        before the WAL (and every follower) can see a stale-regime record."""
+        old = self._journal(tmp_path)
+        old.fence(1)
+        old.published(standing_set(1))
+        # a promoted follower on the same directory: recover, fence epoch+1
+        new = self._journal(tmp_path)
+        standing, _, _, epoch = new.recover()
+        assert standing is not None and standing.version == 1
+        assert epoch == 1
+        new.fence(epoch + 1)
+        with pytest.raises(FencedEpochError) as exc:
+            old.published(standing_set(2))
+        assert exc.value.epoch == 1 and exc.value.current == 2
+        # the refused record never reached the WAL
+        recovered, _, _, _ = self._journal(tmp_path).recover()
+        assert recovered.version == 1
+        # the new holder writes fine
+        new.published(standing_set(2))
+
+    def test_restart_refences_cleanly(self, tmp_path):
+        j = self._journal(tmp_path)
+        j.fence(1)
+        j.published(standing_set(1))
+        # restart: recover + fence(epoch+1) — monotonic, never backwards
+        j2 = self._journal(tmp_path)
+        _, _, _, epoch = j2.recover()
+        j2.fence(epoch + 1)
+        assert j2.epoch == 2 and j2.read_fence() == 2
+        # re-fencing the SAME epoch is idempotent (a retried startup)
+        j2.fence(2)
+        assert j2.read_fence() == 2
+        # fencing backwards is refused
+        with pytest.raises(FencedEpochError):
+            j2.fence(1)
+        j2.published(standing_set(2))
+
+    def test_recover_surfaces_newest_epoch(self, tmp_path):
+        j = self._journal(tmp_path)
+        j.fence(1)
+        j.published(standing_set(1))
+        j.fence(3)
+        _, _, _, epoch = self._journal(tmp_path).recover()
+        assert epoch == 3
+        # sidecar lost (partial directory copy): the journaled epoch records
+        # and per-record stamps still carry the regime
+        os.remove(str(tmp_path / "controller" / ControllerJournal.FENCE_FILE))
+        fresh = self._journal(tmp_path)
+        _, _, _, epoch = fresh.recover()
+        assert epoch == 3
+        assert fresh.epoch == 3   # installed: stale writes still refused
+
+
+# -- the watch hub ------------------------------------------------------------
+
+
+class TestReplicationState:
+    def test_apply_is_idempotent_and_absorbs_regressions(self):
+        s = ReplicationState()
+        s.apply(published_record(2))
+        assert s.set_version == 2 and s.seq == 1
+        s.apply(published_record(2))    # duplicate delivery (tail reset)
+        s.apply(published_record(1))    # version regression (compaction)
+        assert s.set_version == 2 and s.seq == 1   # no delta for either
+        s.apply(published_record(3, superseded=2))
+        assert s.set_version == 3 and s.seq == 2
+
+    def test_epoch_records_emit_once(self):
+        s = ReplicationState()
+        s.apply({"type": "epoch", "epoch": 2})
+        s.apply({"type": "epoch", "epoch": 2})   # duplicate: absorbed
+        s.apply({"type": "epoch", "epoch": 1})   # stale: absorbed
+        assert s.epoch == 2 and s.seq == 1
+        deltas, _, _ = s.watch(0, 0.0)
+        assert [d["kind"] for d in deltas] == ["epoch"]
+
+    def test_watch_cursor_catch_up(self):
+        s = ReplicationState()
+        for v in (1, 2, 3):
+            s.apply(published_record(v))
+        deltas, nxt, resync = s.watch(0, 0.0)
+        assert [d["version"] for d in deltas] == [1, 2, 3]
+        assert nxt == 3 and resync is False
+        deltas, nxt2, resync = s.watch(nxt, 0.0)
+        assert deltas == [] and nxt2 == 3 and resync is False
+        # partial cursor: only the missed suffix comes back
+        deltas, _, _ = s.watch(1, 0.0)
+        assert [d["version"] for d in deltas] == [2, 3]
+
+    def test_watch_ring_falloff_resyncs_with_snapshot(self):
+        s = ReplicationState(ring_size=4)
+        for v in range(1, 11):
+            s.apply(published_record(v))
+        deltas, nxt, resync = s.watch(1, 0.0)   # seq 2 fell off the ring
+        assert resync is True
+        assert len(deltas) == 1 and deltas[0]["kind"] == "published"
+        assert deltas[0]["version"] == 10       # snapshot of the current set
+        assert nxt == s.seq
+        # the watcher continues normally from the resync cursor
+        s.apply(published_record(11))
+        deltas, _, resync = s.watch(nxt, 0.0)
+        assert resync is False and [d["version"] for d in deltas] == [11]
+
+    def test_watch_future_cursor_resyncs_immediately(self):
+        """A cursor from a previous follower incarnation (seq reset) must
+        resync at once, not stall until timeout."""
+        s = ReplicationState()
+        s.apply(published_record(5))
+        t0 = time.monotonic()
+        deltas, nxt, resync = s.watch(999, 5.0)
+        assert time.monotonic() - t0 < 1.0
+        assert resync is True and nxt == s.seq
+        assert deltas[0]["version"] == 5
+
+    def test_watch_long_poll_wakes_on_delta(self):
+        s = ReplicationState()
+        s.apply(published_record(1))
+        _, since, _ = s.watch(0, 0.0)
+
+        def publish_later():
+            time.sleep(0.15)
+            s.apply(published_record(2))
+
+        threading.Thread(target=publish_later).start()
+        t0 = time.monotonic()
+        deltas, _, resync = s.watch(since, 10.0)
+        assert time.monotonic() - t0 < 5.0     # woke, did not ride timeout
+        assert [d["version"] for d in deltas] == [2] and resync is False
+
+    def test_rebase_drained_truncate_clears_the_set(self):
+        """The writer drained + truncated before our poll saw the drain
+        record: the re-delivered WAL is empty — the set is gone and watchers
+        hear about it."""
+        s = ReplicationState()
+        s.apply(published_record(2))
+        s.rebase([])
+        assert s.standing is None
+        deltas, _, _ = s.watch(1, 0.0)
+        assert [d["kind"] for d in deltas] == ["drained"]
+
+    def test_rebase_fresh_wal_regime_serves_lower_version(self):
+        """Operator wiped the directory: the recovered version is BELOW ours
+        — serve it (an empty-handed follower is worse), via an explicit
+        published delta rather than a silent regression."""
+        s = ReplicationState()
+        s.apply(published_record(5))
+        s.rebase([published_record(3)])
+        assert s.standing is not None and s.standing.version == 3
+        # compaction re-delivering the current set is a no-op
+        seq = s.seq
+        s.rebase([published_record(3)])
+        assert s.seq == seq
+
+    def test_stamp_staleness_and_degraded(self):
+        w = ReplicationState(writer=True)
+        assert w.stamp()["role"] == "writer"
+        assert w.stamp()["stalenessMs"] == 0     # writer: zero by construction
+        f = ReplicationState()
+        f.apply(published_record(1))
+        st = f.stamp(degraded_after_ms=10_000)
+        assert st["role"] == "follower" and st["setVersion"] == 1
+        assert st["degraded"] is False
+        f.last_poll_ms -= 60_000                 # tail poll stalled
+        assert f.stamp()["stalenessMs"] >= 60_000
+        f.last_activity_ms -= 60_000             # no records: writer is gone
+        assert f.stamp(degraded_after_ms=10_000)["degraded"] is True
+
+
+# -- journal-level fault injection (ChaosJournal) -----------------------------
+
+
+@pytest.mark.chaos
+class TestChaosJournalFaults:
+    def test_torn_tail_fault_recovers_clean_prefix(self, tmp_path):
+        plan = FaultPlan(seed=7).torn_tail(after_appends=2)
+        j = ChaosJournal(str(tmp_path), plan=plan)
+        j.append({"i": 0})
+        j.append({"i": 1})
+        t = JournalTail(str(tmp_path))
+        assert ids(t.poll()) == [0, 1]
+        with pytest.raises(SimulatedCrash):
+            j.append({"i": 2})               # dies mid-record, torn prefix
+        assert [k for k, _ in j.fault_log] == ["torn_tail"]
+        # a live cursor parks on the torn .open tail — in-progress, not junk
+        assert t.poll() == [] and t.skipped == 0
+        # restart: recovery seals the wreck; replay = the clean prefix
+        j2 = Journal(str(tmp_path))
+        replayed = j2.replay()
+        assert ids(replayed) == [0, 1]
+        assert replayed.skipped == 1
+        # the sealed torn line becomes a permanent skip; the WAL flows on
+        j2.append({"i": 2})
+        assert ids(t.poll()) == [2]
+        assert t.skipped == 1 and t.resets == 0
+
+    def test_fsync_lost_suffix_shrinks_to_survivors(self, tmp_path):
+        """Process death with the page-cache suffix unflushed: the last
+        ``lose`` records evaporate.  Recovery serves the survivors; a cursor
+        that already read the doomed suffix detects the shrink (same inode,
+        smaller size) and resets rather than serving a stale offset."""
+        plan = FaultPlan(seed=7).lose_fsync_suffix(after_appends=3, lose=2)
+        j = ChaosJournal(str(tmp_path), plan=plan)
+        for i in range(3):
+            j.append({"i": i})
+        t = JournalTail(str(tmp_path))
+        assert ids(t.poll()) == [0, 1, 2]    # includes the doomed suffix
+        with pytest.raises(SimulatedCrash):
+            j.append({"i": 3})
+        assert ids(Journal(str(tmp_path)).replay()) == [0]
+        got = []
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and t.resets == 0:
+            got.extend(ids(t.poll()))
+        got.extend(ids(t.poll()))
+        assert t.resets == 1
+        assert got == [0]                    # prefix re-delivered, no junk
+
+    def test_rotation_crash_strands_then_seals_full_segment(self, tmp_path):
+        """Death between a rotation's close and its rename strands a COMPLETE
+        segment under its .open name: nothing is lost, recovery seals it, and
+        a live cursor crosses the transition without a reset."""
+        plan = FaultPlan(seed=7).rotation_crash(rotation_no=1)
+        j = ChaosJournal(str(tmp_path), plan=plan, max_segment_records=3)
+        j.append({"i": 0})
+        j.append({"i": 1})
+        t = JournalTail(str(tmp_path))
+        assert ids(t.poll()) == [0, 1]
+        with pytest.raises(SimulatedCrash):
+            j.append({"i": 2})               # record written; rotation dies
+        assert os.path.exists(str(tmp_path / "segment-000000.jsonl.open"))
+        assert ids(t.poll()) == [2]          # the stranded record still reads
+        # restart seals the stranded segment and continues in the next one
+        j2 = Journal(str(tmp_path), max_segment_records=3)
+        assert os.path.exists(str(tmp_path / "segment-000000.jsonl"))
+        j2.append({"i": 3})
+        assert ids(t.poll()) == [3]
+        assert t.resets == 0 and t.skipped == 0
+        assert ids(Journal(str(tmp_path)).replay()) == [0, 1, 2, 3]
+
+
+# -- follower serving over real HTTP ------------------------------------------
+
+
+def base_props(**overrides):
+    props = {
+        "partition.metrics.window.ms": WINDOW_MS,
+        "num.partition.metrics.windows": 4,
+        "metric.sampling.interval.ms": 3_600_000,
+        "anomaly.detection.interval.ms": 3_600_000,
+        "anomaly.detection.initial.pass": False,
+        "broker.capacity.config.resolver.class":
+            "cruise_control_tpu.monitor.capacity.StaticCapacityResolver",
+        "sample.store.class":
+            "cruise_control_tpu.monitor.samplestore.NoopSampleStore",
+        "webserver.http.port": 0,
+        "min.valid.partition.ratio": 0.5,
+        "default.goals": TRIMMED_GOALS,
+    }
+    props.update(overrides)
+    return props
+
+
+def seeded_backend(num_brokers=4, partitions=12):
+    from cruise_control_tpu.backend import FakeClusterBackend
+
+    backend = FakeClusterBackend()
+    for b in range(num_brokers):
+        backend.add_broker(b, rack=str(b % 2))
+    for p in range(partitions):
+        backend.create_partition(
+            ("T", p), [p % 2, (p % 2 + 1) % num_brokers],
+            load=[1.5, 4e3, 6e3, 3e4],
+        )
+    return backend
+
+
+def make_app(**overrides):
+    from cruise_control_tpu.app import CruiseControlTpuApp
+    from cruise_control_tpu.core.resources import Resource
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+
+    app = CruiseControlTpuApp(base_props(**overrides), backend=seeded_backend())
+    app.monitor.capacity_resolver = StaticCapacityResolver(
+        {Resource.CPU: 100.0, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6,
+         Resource.DISK: 1e7}
+    )
+    return app
+
+
+def http_get(port: int, path: str, timeout: float = 30.0):
+    import urllib.error
+    import urllib.request
+
+    url = f"http://127.0.0.1:{port}/kafkacruisecontrol/{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            body = json.loads(raw) if raw else {}
+        except ValueError:
+            body = {}
+        return e.code, dict(e.headers), body
+
+
+def http_post(port: int, path: str, timeout: float = 30.0):
+    import urllib.error
+    import urllib.request
+
+    url = f"http://127.0.0.1:{port}/kafkacruisecontrol/{path}"
+    req = urllib.request.Request(url, method="POST", data=b"")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            body = json.loads(raw) if raw else {}
+        except ValueError:
+            body = {}
+        return e.code, dict(e.headers), body
+
+
+def poll_until(pred, timeout_s=20.0, desc="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+@pytest.fixture(scope="module")
+def repl_pair(tmp_path_factory):
+    """One writer app (controller enabled, fenced WAL) + one follower app
+    tailing the same journal directory, both serving HTTP in-process."""
+    jdir = str(tmp_path_factory.mktemp("repl"))
+    writer = make_app(**{
+        "journal.dir": jdir,
+        "controller.enable": True,
+        "controller.tick.interval.ms": 3_600_000,
+        "replication.role": "writer",
+    })
+    writer.start(serve_http=True)
+    follower = make_app(**{
+        "journal.dir": jdir,
+        "replication.role": "follower",
+        "replication.poll.interval.ms": 20,
+    })
+    follower.start(serve_http=True)
+    yield writer, follower
+    follower.stop()
+    writer.stop()
+
+
+class TestFollowerServing:
+    def test_roles_epoch_and_stamped_reads(self, repl_pair):
+        writer, follower = repl_pair
+        # the writer's startup recovery fenced epoch 1; the follower's first
+        # synchronous tail poll already saw the epoch record
+        _, _, body = http_get(writer.port, "state?substates=controller")
+        assert body["replication"]["role"] == "writer"
+        poll_until(lambda: follower._replication.epoch == 1,
+                   desc="follower sees the fence record")
+        status, _, body = http_get(follower.port, "state?substates=controller")
+        assert status == 200
+        stamp = body["replication"]
+        assert stamp["role"] == "follower" and stamp["epoch"] == 1
+        assert stamp["degraded"] is False
+
+    def test_follower_refuses_mutations_with_retry_after(self, repl_pair):
+        _, follower = repl_pair
+        status, headers, body = http_post(
+            follower.port, "rebalance?dryrun=true&json=true"
+        )
+        assert status == 503
+        assert float(headers.get("Retry-After")) >= 1
+        assert "follower" in json.dumps(body)
+
+    def test_publish_propagates_to_follower_watch(self, repl_pair):
+        writer, follower = repl_pair
+        # write-path publish on the writer's fenced journal: the in-process
+        # listener stamps the writer's own view synchronously...
+        writer.controller.journal.published(standing_set(1))
+        _, _, body = http_get(writer.port, "watch?since=0&timeout_ms=0")
+        assert any(
+            d["kind"] == "published" and d["version"] == 1
+            for d in body["deltas"]
+        )
+        assert body["replication"]["setVersion"] == 1
+        # ...and the follower's tailer folds the same bytes within its poll
+        # cadence, visible through a long-poll WATCH
+        deadline = time.monotonic() + 20.0
+        since, seen = 0, []
+        while time.monotonic() < deadline:
+            _, _, body = http_get(
+                follower.port, f"watch?since={since}&timeout_ms=1000"
+            )
+            seen.extend(body["deltas"])
+            since = body["since"]
+            if any(d["kind"] == "published" and d["version"] == 1
+                   for d in seen):
+                break
+        assert any(d["kind"] == "published" and d["version"] == 1
+                   for d in seen), seen
+        poll_until(
+            lambda: http_get(follower.port, "state?substates=controller")
+            [2]["replication"]["setVersion"] == 1,
+            desc="follower stamp converges to v1",
+        )
+
+    def test_long_poll_wakes_within_publish_latency(self, repl_pair):
+        writer, follower = repl_pair
+        _, _, body = http_get(follower.port, "watch?since=0&timeout_ms=0")
+        since = body["since"]
+
+        def publish_later():
+            time.sleep(0.2)
+            writer.controller.journal.published(standing_set(2))
+
+        threading.Thread(target=publish_later).start()
+        t0 = time.monotonic()
+        status, _, body = http_get(
+            follower.port, f"watch?since={since}&timeout_ms=15000"
+        )
+        wall = time.monotonic() - t0
+        assert status == 200
+        assert any(d["kind"] == "published" and d["version"] == 2
+                   for d in body["deltas"])
+        assert wall < 10.0     # woke on the delta, did not ride the timeout
+
+    def test_lag_bound_503_with_derived_retry_after(self, repl_pair):
+        """A follower whose tail poll stalls past replication.lag.bound.ms
+        refuses staleness-sensitive reads with 503 + a staleness-derived
+        Retry-After; liveness stays exempt."""
+        _, follower = repl_pair
+        follower._follower_tailer.stop()
+        try:
+            follower._replication.last_poll_ms -= 60_000
+            status, headers, _ = http_get(
+                follower.port, "state?substates=controller"
+            )
+            assert status == 503
+            assert float(headers.get("Retry-After")) >= 1
+            status, _, _ = http_get(follower.port, "healthz")
+            assert status == 200   # liveness never gated on replica lag
+        finally:
+            follower._replication.note_poll()
+            follower._follower_tailer._stop.clear()
+            follower._follower_tailer.start()
+        status, _, _ = http_get(follower.port, "state?substates=controller")
+        assert status == 200

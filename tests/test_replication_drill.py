@@ -1,0 +1,282 @@
+"""Replication failover drill (ISSUE 17 headline, multi-process).
+
+The writer — a real :class:`ContinuousController` ticking over the harness
+cluster — is chaos-killed in the worst window there is: *after* the v2
+publish reached the fenced WAL, *before* the in-memory swap
+(``_hook_after_journal_publish``).  Two real follower processes tail the
+same journal directory with open long-poll watches throughout.  The drill
+then asserts the whole failover contract:
+
+* followers keep answering (zero 5xx) and deliver the journaled v2 — the
+  set the dead writer never swapped in — to every open watcher;
+* with no writer appends, follower reads flip to ``degraded=true`` after
+  ``replication.degraded.after.ms`` while still serving the standing set;
+* the restarted writer recovers v2 from the WAL and fences ``epoch+1``;
+  the dead incarnation's journal handle gets :class:`FencedEpochError` on
+  its next append — split-brain double-publish is refused at the WAL, so
+  no follower can ever see it;
+* watchers observe the epoch bump and the new regime's v3, and at no point
+  does any watcher observe a version regression.
+
+Marked ``slow`` (two full optimize ticks + two subprocess boots); CI runs
+this file by name in its own step, as does scripts/ci_local.sh.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu.controller import bench as cbench
+from cruise_control_tpu.controller.loop import ControllerConfig
+from cruise_control_tpu.controller.standing import (
+    ControllerJournal,
+    FencedEpochError,
+    StandingProposalSet,
+)
+from cruise_control_tpu.core.journal import Journal, SimulatedCrash
+from cruise_control_tpu.replication import bench as rbench
+
+pytestmark = pytest.mark.slow
+
+WINDOW_MS = cbench.WINDOW_MS
+
+TICK_CFG = dict(
+    tick_interval_s=3_600.0,   # cadence off — drift (or force) triggers
+    drift_threshold=1.0,
+    max_rounds_per_tick=1,
+)
+
+#: follower knobs for the drill: fast tail cadence, and a degraded
+#: threshold short enough to observe inside the test budget
+FOLLOWER_PROPS = {
+    "replication.poll.interval.ms": 20,
+    "replication.degraded.after.ms": 1_500,
+}
+
+
+def feed_shift(monitor, now_ms: int) -> int:
+    """Two windows so the shifted samples land in a STABLE window."""
+    now_ms += WINDOW_MS
+    monitor.sample_once(now_ms=now_ms)
+    now_ms += WINDOW_MS
+    monitor.sample_once(now_ms=now_ms)
+    return now_ms
+
+
+def apply_shift(backend, controller, victim: int, prev_hot):
+    for tp in prev_hot:
+        backend.set_partition_load(tp, list(cbench.BASE_LOAD))
+    hot = cbench.hot_partitions_on(controller, victim)
+    for tp in hot:
+        backend.set_partition_load(tp, [0.2, 50.0, 50.0, cbench.HOT_DISK])
+    return hot
+
+
+class Watcher(threading.Thread):
+    """Re-arming long-poll watcher against one follower: records every delta
+    in arrival order plus any 5xx — the no-regression/no-split-brain witness."""
+
+    def __init__(self, port: int) -> None:
+        super().__init__(daemon=True)
+        self.port = port
+        self.deltas: list = []
+        self.http_5xx = 0
+        self.stop_evt = threading.Event()
+        self._since = 0
+
+    def run(self) -> None:
+        while not self.stop_evt.is_set():
+            out = rbench._get(
+                f"http://127.0.0.1:{self.port}/kafkacruisecontrol/watch"
+                f"?since={self._since}&timeout_ms=1000&json=true",
+                timeout=30.0,
+            )
+            if out["status"] >= 500:
+                self.http_5xx += 1
+                time.sleep(0.05)
+                continue
+            body = out["body"]
+            self.deltas.extend(body.get("deltas", []))
+            self._since = body.get("since", self._since)
+
+    def versions(self, kind: str = "published"):
+        return [d["version"] for d in self.deltas if d.get("kind") == kind]
+
+    def epochs(self):
+        return [d["epoch"] for d in self.deltas if "epoch" in d]
+
+
+def wait_for(pred, timeout_s: float, desc: str):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"drill timed out waiting for: {desc}")
+
+
+def follower_stamp(port: int) -> dict:
+    out = rbench._get(
+        f"http://127.0.0.1:{port}/kafkacruisecontrol/state"
+        "?substates=controller&json=true",
+        timeout=30.0,
+    )
+    if out["status"] != 200:
+        return {"status": out["status"]}
+    stamp = dict(out["body"]["replication"])
+    stamp["status"] = 200
+    return stamp
+
+
+def test_writer_killed_mid_publish_followers_failover(tmp_path):
+    jdir = str(tmp_path)
+    journal = ControllerJournal(Journal(os.path.join(jdir, "controller")))
+    cfg = ControllerConfig(**TICK_CFG)
+    backend, monitor, controller, now_ms = cbench.build_harness(
+        journal=journal, config=cfg
+    )
+    controller.recover()           # empty WAL: fences epoch 1
+    assert journal.epoch == 1
+    controller.warm_start()
+
+    # -- v1: a real drift tick publishes through the fenced WAL ---------------
+    hot = apply_shift(backend, controller, 0, [])
+    now_ms = feed_shift(monitor, now_ms)
+    s1 = controller.maybe_tick()
+    assert s1 is not None and s1.version == 1 and s1.epoch == 1
+
+    followers = []
+    watchers = []
+    try:
+        # -- two real follower processes tail the same directory --------------
+        for i in range(2):
+            port_file = str(tmp_path / f"follower-{i}.port")
+            proc = rbench._spawn_follower(
+                jdir, port_file, extra_props=FOLLOWER_PROPS
+            )
+            followers.append((proc, port_file))
+        boot_deadline = time.monotonic() + rbench.FOLLOWER_BOOT_TIMEOUT_S
+        ports = [
+            rbench._await_port(pf, proc, boot_deadline)
+            for proc, pf in followers
+        ]
+        for port in ports:
+            wait_for(
+                lambda p=port: follower_stamp(p).get("setVersion") == 1,
+                30.0, f"follower :{port} serves v1",
+            )
+
+        # -- open watches, then kill the writer between append and swap -------
+        for port in ports:
+            w = Watcher(port)
+            w.start()
+            watchers.append(w)
+        wait_for(
+            lambda: all(1 in w.versions() for w in watchers),
+            20.0, "all watchers saw published v1",
+        )
+
+        def die_before_swap():
+            raise SimulatedCrash(
+                "killed between journal append and memory swap"
+            )
+
+        controller._hook_after_journal_publish = die_before_swap
+        apply_shift(backend, controller, 1, hot)
+        now_ms = feed_shift(monitor, now_ms)
+        # the tick appends v2 to the WAL, then "dies" before the in-memory
+        # swap (the publish seam absorbs the crash: nothing else is
+        # journaled, nothing is swapped — exactly a writer killed there)
+        assert controller.maybe_tick() is None
+        assert controller.standing is s1
+        assert controller.standing.version == 1
+        kinds = [
+            (r["type"], r.get("version"))
+            for r in journal.journal.replay()
+        ]
+        assert ("published", 2) in kinds        # the torn window is real
+        assert ("invalidated", 1) not in kinds  # ...and nothing after it
+
+        # -- followers keep serving; v2 reaches every open watcher ------------
+        wait_for(
+            lambda: all(2 in w.versions() for w in watchers),
+            20.0, "all watchers saw the journaled v2",
+        )
+        # no writer appends since the kill: degraded flips on, reads still 200
+        wait_for(
+            lambda: all(
+                follower_stamp(p).get("degraded") is True for p in ports
+            ),
+            20.0, "follower reads flip degraded=true",
+        )
+        for port in ports:
+            stamp = follower_stamp(port)
+            assert stamp["status"] == 200
+            assert stamp["setVersion"] == 2 and stamp["epoch"] == 1
+
+        # -- restart the writer on the same directory: recover + re-fence -----
+        restarted = ControllerJournal(Journal(os.path.join(jdir, "controller")))
+        standing, _, _, epoch = restarted.recover()
+        assert standing is not None and standing.version == 2
+        assert epoch == 1
+        restarted.fence(epoch + 1)
+
+        # the dead incarnation tries its double-publish: refused at the WAL
+        with pytest.raises(FencedEpochError) as exc:
+            journal.published(
+                StandingProposalSet(
+                    version=3, created_ms=123, trigger="drift", drift=2.0,
+                    proposals=list(s1.proposals), reaction_s=0.01,
+                )
+            )
+        assert exc.value.current == 2
+
+        # -- the new regime publishes v3; watchers see epoch bump + v3 --------
+        restarted.published(
+            StandingProposalSet(
+                version=3, created_ms=456, trigger="recovered-regime",
+                drift=1.0, proposals=list(standing.proposals),
+                reaction_s=None,
+            )
+        )
+        wait_for(
+            lambda: all(3 in w.versions() for w in watchers),
+            20.0, "all watchers saw the new regime's v3",
+        )
+        wait_for(
+            lambda: all(2 in w.epochs() for w in watchers),
+            20.0, "all watchers saw the epoch bump",
+        )
+        for port in ports:
+            stamp = follower_stamp(port)
+            assert stamp["setVersion"] == 3 and stamp["epoch"] == 2
+            assert stamp["degraded"] is False   # the new writer is appending
+
+        # -- the full-history invariants --------------------------------------
+        for w in watchers:
+            assert w.http_5xx == 0
+            seen = w.versions()
+            assert seen == sorted(seen), f"version regression: {seen}"
+            assert len(set(seen)) == len(seen), f"double-publish: {seen}"
+            epochs = w.epochs()
+            assert epochs == sorted(epochs), f"epoch regression: {epochs}"
+    finally:
+        for w in watchers:
+            w.stop_evt.set()
+        for w in watchers:
+            w.join(timeout=10)
+        for proc, _ in followers:
+            try:
+                if proc.stdin:
+                    proc.stdin.close()
+            except OSError:
+                pass
+        for proc, _ in followers:
+            try:
+                proc.wait(timeout=15)
+            except Exception:
+                proc.kill()
