@@ -352,6 +352,24 @@ class IncrementalResult:
 
 
 @dataclasses.dataclass
+class BatchedIncrementalResult:
+    """Outcome of one :meth:`GoalOptimizer.batched_incremental_optimize` pass.
+
+    The fleet controller's tick result: ``results[i]`` is lane *i*'s
+    :class:`IncrementalResult` (its own drifted goals, its own before/after
+    violation vectors), while the dispatch budget is shared by the whole
+    stack — ``goals_run`` is the UNION of drifted goals across the driving
+    lanes and ``num_dispatches`` covers all lanes together (the batch is the
+    dispatch unit, not the lane)."""
+
+    results: List[IncrementalResult]
+    goals_run: List[str]
+    batch_size: int
+    num_dispatches: int
+    duration_s: float
+
+
+@dataclasses.dataclass
 class BatchedResult:
     """Outcome of one :meth:`GoalOptimizer.batched_optimize` call.
 
@@ -578,6 +596,12 @@ _goal_step_don = profile_jit(
         _goal_step_fn
     ),
 )
+_goal_step_b = profile_jit(
+    "optimizer.goal_step_batched",
+    partial(jax.jit, static_argnames=_GOAL_STEP_STATICS)(
+        _vmap_step(_goal_step_fn)
+    ),
+)
 _goal_step_b_don = profile_jit(
     "optimizer.goal_step_batched",
     partial(jax.jit, static_argnames=_GOAL_STEP_STATICS, donate_argnums=(0,))(
@@ -617,6 +641,12 @@ _assigner_step_don = profile_jit(
         _assigner_step_fn
     ),
 )
+_assigner_step_b = profile_jit(
+    "optimizer.assigner_step_batched",
+    partial(jax.jit, static_argnames=_ASSIGNER_STATICS)(
+        _vmap_step(_assigner_step_fn)
+    ),
+)
 _assigner_step_b_don = profile_jit(
     "optimizer.assigner_step_batched",
     partial(jax.jit, static_argnames=_ASSIGNER_STATICS, donate_argnums=(0,))(
@@ -637,6 +667,23 @@ def _max_replication_factor(state: ClusterArrays) -> int:
         np.asarray(state.replica_partition)[valid], minlength=state.num_partitions
     )
     return max(int(counts.max()), 1)
+
+
+def _max_replication_factor_b(states: ClusterArrays) -> int:
+    """Host-side maxRF over a stacked scenario axis: the assigner's position
+    loop is static per compiled program, so the whole batch shares the max."""
+    import numpy as np
+
+    valid = np.asarray(states.replica_valid)
+    rp = np.asarray(states.replica_partition)
+    best = 1
+    for i in range(valid.shape[0]):
+        v = valid[i]
+        if not v.any():
+            continue
+        counts = np.bincount(rp[i][v], minlength=states.num_partitions)
+        best = max(best, int(counts.max()))
+    return best
 
 
 def _violations_fn(state, ctx, enable_heavy=False, subset=None, spmd=None):
@@ -1562,4 +1609,168 @@ class GoalOptimizer:
             total_rounds=int(sum(int(r) for r, _ in fetched)),
             num_dispatches=dispatches,
             duration_s=time.monotonic() - t0,
+        )
+
+    def batched_violations(self, states: ClusterArrays, ctx: GoalContext):
+        """[S, NUM_GOALS] violation probe over a stacked lane axis (shared
+        context) — the fleet's whole-tick drift probe is this ONE vmapped
+        dispatch.  ``states`` may hold host-numpy leaves (the fleet's mirror
+        path): the jit boundary transfers once, no eager device ops."""
+        return _violations_b(
+            states, ctx,
+            enable_heavy=self.enable_heavy_goals, subset=self.goal_ids,
+        )
+
+    def warm_batched_incremental_programs(
+        self, states: ClusterArrays, ctx: GoalContext, max_rounds: int
+    ) -> None:
+        """Batched analogue of :meth:`warm_incremental_programs`: pre-compile
+        every executable :meth:`batched_incremental_optimize` can touch at
+        this stacked shape — the vmapped violations probe, the non-donating
+        ``_goal_step_b`` twin of every goal (any goal can be the first of a
+        fleet tick), and the donating chain via one all-goals-violated pass
+        over a throwaway device copy.  Idempotent; ~free once cached."""
+        import numpy as np
+
+        jax.block_until_ready(self.batched_violations(states, ctx))
+        heavy = self.enable_heavy_goals
+        max_rounds = int(max_rounds)
+        prior: Tuple[int, ...] = ()
+        for gid in self.goal_ids:
+            if gid == G.KAFKA_ASSIGNER_RACK:
+                _assigner_step_b(
+                    states, ctx,
+                    max_rf=_max_replication_factor_b(states), enable_heavy=heavy,
+                )
+            else:
+                _goal_step_b(
+                    states, ctx,
+                    gid=gid, round_fns=GOAL_ROUNDS[gid],
+                    max_rounds=max_rounds, enable_heavy=heavy,
+                    prior_ids=prior, admit_ids=prior + (gid,),
+                )
+            prior = prior + (gid,)
+        scratch = jax.device_put(jax.device_get(states))
+        S = int(np.asarray(scratch.replica_valid).shape[0])
+        self.batched_incremental_optimize(
+            scratch, ctx, max_rounds=max_rounds,
+            violations=np.ones((S, G.NUM_GOALS), np.float32),
+        )
+
+    def batched_incremental_optimize(
+        self,
+        states: ClusterArrays,
+        ctx: GoalContext,
+        max_rounds: int,
+        violations=None,
+        union_lanes=None,
+    ) -> Tuple[ClusterArrays, BatchedIncrementalResult]:
+        """Bounded re-optimize of a stacked lane axis from the CURRENT
+        placements — the fleet controller's tick kernel: N tenants pay ONE
+        compiled dispatch per violated goal instead of N.
+
+        The goal walk runs the UNION of violated goals across the driving
+        lanes (``union_lanes``, default all) — a batched program is one static
+        goal sequence for every lane, so a lane is carried through union goals
+        it does not itself violate.  That is exact, not approximate: a goal
+        step on a state that satisfies the goal is a zero-move rotation (a
+        converged state is a fixpoint of its own rounds), so that lane's
+        placement is bit-unchanged — only its round counters absorb the trip.
+        Full-walk prior prefixes keep the static tuples identical to the
+        single-lane :meth:`incremental_optimize` walk, so warm fleet ticks
+        reuse the same executables (0-compile warm-tick contract).
+
+        ``states`` may carry host-numpy leaves (the fleet's host mirrors);
+        the first goal step consumes them through the NON-donating batched
+        jit (no donation of caller-owned host buffers), every later step
+        donates the intermediate it owns.  Returns the final states as a
+        HOST pytree (one bulk fetch) plus per-lane results.
+        """
+        import numpy as np
+
+        t0 = time.monotonic()
+        heavy = self.enable_heavy_goals
+        dispatches = 0
+        if violations is None:
+            viol0_np = np.asarray(jax.device_get(
+                self.batched_violations(states, ctx)
+            ))
+            dispatches += 1
+        else:
+            viol0_np = np.asarray(violations)
+        S = int(viol0_np.shape[0])
+        lanes = range(S) if union_lanes is None else sorted(
+            int(i) for i in union_lanes
+        )
+        drifted_by_lane = [
+            {g for g in self.goal_ids if float(viol0_np[i, g]) > 0}
+            for i in range(S)
+        ]
+        union: set = set()
+        for i in lanes:
+            union |= drifted_by_lane[i]
+
+        max_rounds = int(max_rounds)
+        raw: List[tuple] = []
+        goals_run_union: List[str] = []
+        prior: Tuple[int, ...] = ()
+        first = True
+        for gid in self.goal_ids:
+            if gid in union:
+                if gid == G.KAFKA_ASSIGNER_RACK:
+                    step = _assigner_step_b if first else _assigner_step_b_don
+                    states, rounds, moves, before, after, _ = step(
+                        states, ctx,
+                        max_rf=_max_replication_factor_b(states),
+                        enable_heavy=heavy,
+                    )
+                else:
+                    step = _goal_step_b if first else _goal_step_b_don
+                    states, rounds, moves, before, after = step(
+                        states, ctx,
+                        gid=gid,
+                        round_fns=GOAL_ROUNDS[gid],
+                        max_rounds=max_rounds,
+                        enable_heavy=heavy,
+                        prior_ids=prior, admit_ids=prior + (gid,),
+                    )
+                first = False
+                dispatches += 1
+                raw.append((gid, rounds, moves))
+                goals_run_union.append(G.GOAL_NAMES[gid])
+            prior = prior + (gid,)
+
+        violN = _violations_b(
+            states, ctx, enable_heavy=heavy, subset=self.goal_ids
+        )
+        dispatches += 1
+        # ONE bulk fetch: final violation matrix, per-goal [S] counters, and
+        # the final states (host pytree — the fleet's next-tick mirrors)
+        violN_np, fetched, final_host = jax.device_get(
+            (violN, [(r, m) for _, r, m in raw], states)
+        )
+        violN_np = np.asarray(violN_np)
+        duration = time.monotonic() - t0
+
+        results: List[IncrementalResult] = []
+        for i in range(S):
+            ran_i = [
+                g for g in self.goal_ids
+                if g in union and g in drifted_by_lane[i]
+            ]
+            results.append(IncrementalResult(
+                goals_run=[G.GOAL_NAMES[g] for g in ran_i],
+                violations_before=viol0_np[i],
+                violations_after=violN_np[i],
+                total_moves=int(sum(int(np.asarray(m)[i]) for _, m in fetched)),
+                total_rounds=int(sum(int(np.asarray(r)[i]) for r, _ in fetched)),
+                num_dispatches=dispatches,
+                duration_s=duration,
+            ))
+        return final_host, BatchedIncrementalResult(
+            results=results,
+            goals_run=goals_run_union,
+            batch_size=S,
+            num_dispatches=dispatches,
+            duration_s=duration,
         )

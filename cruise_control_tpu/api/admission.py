@@ -85,7 +85,7 @@ ANONYMOUS_PRINCIPAL = "(anonymous)"
 #: hatches (an emergency stop that can be rate-limited is not one)
 CHEAP_ENDPOINTS = {
     "HEALTHZ", "METRICS", "STATE", "TRACES", "USER_TASKS", "PERMISSIONS",
-    "REVIEW_BOARD", "CONTROLLER", "ADMIN", "REVIEW",
+    "REVIEW_BOARD", "CONTROLLER", "FLEET", "ADMIN", "REVIEW",
     "STOP_PROPOSAL_EXECUTION", "WATCH",
 }
 
@@ -250,19 +250,47 @@ class AdmissionController:
         self.admitted = 0
         self.shed = 0
         self.shed_by_reason: Dict[str, int] = {}
+        self.shed_by_principal: Dict[str, int] = {}
+        #: principal → queue tier, set by the fleet controller (tenant →
+        #: principal tier threading): a named tenant's requests queue at its
+        #: configured tier regardless of role/anonymity, so a noisy low-tier
+        #: tenant drains AFTER every higher tier even when both are anonymous
+        self._tier_overrides: Dict[str, int] = {}
 
     # -- classification ------------------------------------------------------
 
-    def tier_of(self, role: Optional[Role], anonymous: bool) -> int:
+    def set_tier_override(self, principal: str, tier: int) -> None:
+        """Pin a principal's queue tier (fleet tenant → tier mapping)."""
+        self._tier_overrides[principal] = int(tier)
+
+    def tier_of(
+        self,
+        role: Optional[Role],
+        anonymous: bool,
+        principal: Optional[str] = None,
+    ) -> int:
+        if principal is not None and principal in self._tier_overrides:
+            return self._tier_overrides[principal]
         if anonymous or role is None:
             return self.cfg.default_tier
         return TIER_BY_ROLE.get(role, self.cfg.default_tier)
 
-    def priority(self, endpoint: str, role: Optional[Role], anonymous: bool) -> int:
+    def priority(
+        self,
+        endpoint: str,
+        role: Optional[Role],
+        anonymous: bool,
+        principal: Optional[str] = None,
+    ) -> int:
         # class dominates tier: a tenant's corrective mutation still outranks
-        # an operator's speculative sweep (the sweep can always wait)
-        return endpoint_class_rank(endpoint) * (max(TIER_BY_ROLE.values()) + 2) + (
-            self.tier_of(role, anonymous)
+        # an operator's speculative sweep (the sweep can always wait).  The
+        # tier slot is sized for the largest role tier or tenant override in
+        # play, so an override can only reorder WITHIN an endpoint class.
+        max_tier = max(TIER_BY_ROLE.values())
+        if self._tier_overrides:
+            max_tier = max(max_tier, max(self._tier_overrides.values()))
+        return endpoint_class_rank(endpoint) * (max_tier + 2) + (
+            self.tier_of(role, anonymous, principal=principal)
         )
 
     # -- shedding ------------------------------------------------------------
@@ -275,6 +303,9 @@ class AdmissionController:
 
         self.shed += 1
         self.shed_by_reason[reason] = self.shed_by_reason.get(reason, 0) + 1
+        self.shed_by_principal[principal] = (
+            self.shed_by_principal.get(principal, 0) + 1
+        )
         REGISTRY.counter(ADMISSION_SHED_COUNTER).inc()
         REGISTRY.counter(counter).inc()
         token = obs.start_trace("admission")
@@ -361,7 +392,7 @@ class AdmissionController:
         if not self.cfg.enabled:
             return None
         quota = self.cfg.max_tasks_per_principal
-        prio = self.priority(endpoint, role, anonymous)
+        prio = self.priority(endpoint, role, anonymous, principal=principal)
         with self._cv:
             if quota and self._active_by_principal.get(principal, 0) >= quota:
                 # waiting cannot help: the principal's own backlog is the
@@ -466,8 +497,10 @@ class AdmissionController:
                 "admitted": self.admitted,
                 "shed": self.shed,
                 "shedByReason": dict(self.shed_by_reason),
+                "shedByPrincipal": dict(self.shed_by_principal),
                 "active": self._active,
                 "activeByPrincipal": dict(self._active_by_principal),
+                "tierOverrides": dict(self._tier_overrides),
                 "queueDepth": len(self._waiters),
                 "queueCapacity": self.cfg.queue_capacity,
                 "maxConcurrent": self.cfg.max_concurrent,

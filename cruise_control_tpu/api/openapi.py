@@ -76,9 +76,9 @@ _SHED_RESPONSE = {
 }
 
 #: POSTs that answer synchronously in the handler thread — no user task, no
-#: 202, no async params (CONTROLLER pause/resume/tick is a switch on the
-#: control loop, never a long-running operation)
-_SYNC_POST_ENDPOINTS = {"CONTROLLER"}
+#: 202, no async params (CONTROLLER/FLEET pause/resume/tick is a switch on
+#: a control loop, never a long-running operation)
+_SYNC_POST_ENDPOINTS = {"CONTROLLER", "FLEET"}
 
 #: endpoint-specific query parameters beyond the common/async sets.  A param
 #: carrying a ``"methods"`` key is emitted only for those methods (needed by
@@ -159,6 +159,24 @@ _ENDPOINT_PARAMS = {
          "schema": {"type": "string"},
          "description": "operator note recorded with pause/resume",
          "methods": ["post"]},
+    ],
+    "FLEET": [
+        {"name": "action", "in": "query", "required": False,
+         "schema": {"type": "string", "enum": ["pause", "resume", "tick"]},
+         "description": ("pause/resume the fleet controller, or force one "
+                         "synchronous fleet evaluation (GET returns the "
+                         "status: per-tenant control-loop blocks plus the "
+                         "last tick's batching census)"),
+         "methods": ["post"]},
+        {"name": "reason", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": "operator note recorded with pause/resume",
+         "methods": ["post"]},
+        {"name": "tenant", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": ("narrow to one tenant: GET answers that tenant's "
+                         "status block; POST pause/resume flips only that "
+                         "tenant, tick forces only that tenant's lane")},
     ],
     "TRACES": [
         {"name": "kind", "in": "query", "required": False,

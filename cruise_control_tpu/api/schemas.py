@@ -141,6 +141,24 @@ _CONTROLLER_STATUS = {
     "?action": str,                  # echoed by POST
 }
 
+_FLEET_STATUS = {
+    "enabled": bool,
+    #: fields below only when the fleet is configured (fleet.enable)
+    "?state": str,                   # running | paused
+    "?paused": bool,
+    "?pauseReason": (str, None),
+    "?tenantCount": int,
+    #: tenant name -> that tenant's _CONTROLLER_STATUS-shaped block (+tier)
+    "?tenants": dict,
+    #: the batching census of the last fleet tick: tenants, goal-order
+    #: groups, probe/optimize dispatch counts, tenants_per_dispatch
+    "?lastTick": (dict, None),
+    "?config": dict,
+    "?action": str,                  # echoed by POST
+    #: present when the answer was narrowed with ?tenant=<name>
+    "?tenant": str,
+}
+
 _READINESS = {
     "state": str,
     "ready": bool,
@@ -202,9 +220,11 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
         "?Admission": _ADMISSION,
         "?Breaker": _BREAKER,
         "?Controller": dict,
+        "?Fleet": dict,
     },
     "HEALTHZ": {"status": str, **_READINESS},
     "CONTROLLER": _CONTROLLER_STATUS,
+    "FLEET": _FLEET_STATUS,
     "LOAD": {"brokers": [_BROKER_LOAD], "?hosts": [dict]},
     "PARTITION_LOAD": {"records": [dict], "?resource": str},
     "PROPOSALS": {

@@ -65,7 +65,7 @@ API_PREFIX = "/kafkacruisecontrol/"
 GET_ENDPOINTS = {
     "STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE",
     "USER_TASKS", "REVIEW_BOARD", "PERMISSIONS", "BOOTSTRAP", "TRAIN",
-    "TRACES", "METRICS", "HEALTHZ", "CONTROLLER", "WATCH",
+    "TRACES", "METRICS", "HEALTHZ", "CONTROLLER", "WATCH", "FLEET",
 }
 #: endpoints whose 200 body is plain text, not JSON (Prometheus exposition)
 TEXT_ENDPOINTS = {"METRICS"}
@@ -73,14 +73,14 @@ POST_ENDPOINTS = {
     "REBALANCE", "ADD_BROKER", "REMOVE_BROKER", "DEMOTE_BROKER",
     "FIX_OFFLINE_REPLICAS", "STOP_PROPOSAL_EXECUTION", "PAUSE_SAMPLING",
     "RESUME_SAMPLING", "TOPIC_CONFIGURATION", "RIGHTSIZE", "REMOVE_DISKS",
-    "ADMIN", "REVIEW", "SIMULATE", "CONTROLLER", "TRACES",
+    "ADMIN", "REVIEW", "SIMULATE", "CONTROLLER", "TRACES", "FLEET",
 }
 #: POSTs that change cluster state and thus go through two-step verification
 #: (SIMULATE and TRACES are pure what-if evaluations — nothing to review;
-#: CONTROLLER pause/resume flips the control loop, never the cluster —
+#: CONTROLLER/FLEET pause/resume flips a control loop, never the cluster —
 #: parking it in the purgatory would leave the loop unpausable during an
 #: incident)
-REVIEWABLE = POST_ENDPOINTS - {"REVIEW", "SIMULATE", "CONTROLLER", "TRACES"}
+REVIEWABLE = POST_ENDPOINTS - {"REVIEW", "SIMULATE", "CONTROLLER", "TRACES", "FLEET"}
 #: optimize-family endpoints: anything that would build a cluster model and
 #: run the solver is refused with 503 + Retry-After until the process is
 #: ready (journal recovery finished, monitor windows warm) — the k8s-probe
@@ -311,6 +311,7 @@ class CruiseControlApp:
         readiness: Optional[ReadinessController] = None,
         user_task_journal=None,
         controller=None,
+        fleet=None,
         admission: Optional[AdmissionController] = None,
         breaker=None,
         max_active_user_tasks: int = 25,
@@ -323,6 +324,9 @@ class CruiseControlApp:
         #: the continuous control loop (controller/loop.py), None unless
         #: controller.enable — serves the CONTROLLER endpoint + STATE block
         self.controller = controller
+        #: the multi-tenant fleet controller (fleet/controller.py), None
+        #: unless fleet.enable — serves the FLEET endpoint + STATE block
+        self.fleet = fleet
         self.security = security or NoSecurityProvider()
         self.two_step = two_step_verification
         # embedded/test construction defaults to always-ready; the app shell
@@ -422,6 +426,8 @@ class CruiseControlApp:
         # continuous control loop: drift, standing set, reaction latency
         if self.controller is not None:
             body["Controller"] = self.controller.status()
+        if self.fleet is not None:
+            body["Fleet"] = self.fleet.status()
         return 200, body
 
     def get_healthz(self, params) -> Tuple[int, dict]:
@@ -592,6 +598,26 @@ class CruiseControlApp:
         if self.controller is None:
             return 200, {"enabled": False}
         return 200, {"enabled": True, **self.controller.status()}
+
+    def get_fleet(self, params) -> Tuple[int, dict]:
+        """Fleet-controller status: coordinator state, last-tick batching
+        census (tenants per dispatch, goal-order groups), and one
+        per-tenant status block.  ``tenant=<name>`` narrows the answer to
+        that tenant's block.  Answers ``{"enabled": false}`` when no fleet
+        is configured (``fleet.enable``)."""
+        if self.fleet is None:
+            return 200, {"enabled": False}
+        body = {"enabled": True, **self.fleet.status()}
+        tenant = params.get("tenant", [None])[0]
+        if tenant is not None:
+            block = body["tenants"].get(tenant)
+            if block is None:
+                return 404, {
+                    "error": f"unknown tenant {tenant!r}",
+                    "tenants": sorted(body["tenants"]),
+                }
+            return 200, {"enabled": True, "tenant": tenant, **block}
+        return 200, body
 
     def get_watch(self, params) -> Tuple[int, dict]:
         """Long-poll watch over the standing proposal set: standing-set
@@ -937,6 +963,32 @@ class CruiseControlApp:
         else:
             return 400, {"error": f"action must be pause|resume|tick, got {action!r}"}, {}
         return 200, {"enabled": True, "action": action, **self.controller.status()}, {}
+
+    def post_fleet(self, params):
+        """Operator switch on the fleet: ``action=pause`` / ``resume`` (the
+        whole fleet, or one tenant via ``tenant=<name>``, with optional
+        ``reason``) or ``tick`` (force one synchronous fleet evaluation;
+        with ``tenant`` only that tenant's lane is forced — the others
+        still ride the batched dispatch and trigger on their own drift)."""
+        if self.fleet is None:
+            return 400, {"error": "no fleet configured (fleet.enable)"}, {}
+        action = params.get("action", [None])[0]
+        reason = params.get("reason", ["operator request"])[0]
+        tenant = params.get("tenant", [None])[0]
+        if tenant is not None and tenant not in self.fleet.tenant_names:
+            return 404, {
+                "error": f"unknown tenant {tenant!r}",
+                "tenants": sorted(self.fleet.tenant_names),
+            }, {}
+        if action == "pause":
+            self.fleet.pause(reason, tenant=tenant)
+        elif action == "resume":
+            self.fleet.resume(reason, tenant=tenant)
+        elif action == "tick":
+            self.fleet.maybe_tick(force=True, tenant=tenant)
+        else:
+            return 400, {"error": f"action must be pause|resume|tick, got {action!r}"}, {}
+        return 200, {"enabled": True, "action": action, **self.fleet.status()}, {}
 
     def post_admin(self, params):
         changed = {}

@@ -314,7 +314,11 @@ class CruiseControlTpuApp:
         # triggered incremental rebalancing with a durable standing proposal
         # set (journal.dir namespace <dir>/controller)
         self.controller = None
-        if cfg.get("controller.enable") and self.replication_role == "writer":
+        if (
+            cfg.get("controller.enable")
+            and not cfg.get("fleet.enable")
+            and self.replication_role == "writer"
+        ):
             from cruise_control_tpu.controller import (
                 ContinuousController,
                 ControllerConfig,
@@ -457,6 +461,88 @@ class CruiseControlTpuApp:
                 default_retry_after_s=cfg.get("retry.after.default.s"),
             )
         )
+        # multi-tenant fleet controller (fleet.enable): N tenant clusters,
+        # one batched control plane.  Supersedes the single-tenant loop
+        # (controller.enable is ignored) — the app's primary cluster becomes
+        # the 'default' tenant, adopting a pre-fleet journal.dir/controller
+        # WAL on first startup; extra fleet.tenants get demo-seeded clusters
+        # sampled by the same sampling loop.
+        self.fleet = None
+        self._fleet_monitors = []
+        if cfg.get("fleet.enable") and self.replication_role == "writer":
+            from cruise_control_tpu.fleet import FleetConfig, FleetController
+
+            tiers = {}
+            for part in (cfg.get("fleet.tenant.tiers") or "").split(","):
+                part = part.strip()
+                if part:
+                    tname, _, tval = part.partition(":")
+                    tiers[tname.strip()] = int(tval)
+            self.fleet = FleetController(
+                config=FleetConfig(
+                    tick_interval_s=cfg.get("fleet.tick.interval.ms") / 1000.0,
+                    drift_threshold=cfg.get("fleet.drift.threshold"),
+                    max_rounds_per_tick=cfg.get("fleet.max.rounds.per.tick"),
+                    stale_after_s=cfg.get("fleet.stale.after.ms") / 1000.0,
+                    execute=cfg.get("fleet.execute.enable"),
+                    max_concurrent_drains=cfg.get("fleet.max.concurrent.drains"),
+                    drain_stagger_s=cfg.get("fleet.drain.stagger.ms") / 1000.0,
+                ),
+                journal_dir=jdir or None,
+                journal_kwargs=jkw,
+                breaker=self.breaker,
+                admission=self.admission,
+            )
+            self.fleet.add_tenant(
+                "default", self.cruise_control, tier=tiers.get("default")
+            )
+            from cruise_control_tpu.backend import FakeClusterBackend
+            from cruise_control_tpu.monitor.samples import BackendMetricSampler
+
+            for name in cfg.get("fleet.tenants") or []:
+                if not name or name == "default":
+                    continue
+                tb = FakeClusterBackend()
+                tb.seed_demo(
+                    num_brokers=cfg.get("demo.cluster.brokers") or 8,
+                    num_racks=cfg.get("demo.cluster.racks"),
+                    num_partitions=cfg.get("demo.cluster.partitions"),
+                    replication_factor=cfg.get("demo.cluster.replication.factor"),
+                )
+                tmon = LoadMonitor(
+                    tb,
+                    BackendMetricSampler(tb),
+                    resolver,
+                    num_windows=cfg.get("num.partition.metrics.windows"),
+                    window_ms=cfg.get("partition.metrics.window.ms"),
+                    min_samples_per_window=cfg.get(
+                        "min.samples.per.partition.metrics.window"
+                    ),
+                )
+                tcc = CruiseControl(
+                    tb,
+                    tmon,
+                    Executor(tb),
+                    goal_ids=_goal_ids(cfg.get("default.goals"), G.DEFAULT_GOAL_ORDER),
+                    hard_ids=_goal_ids(cfg.get("hard.goals"), G.HARD_GOALS),
+                    constraint=_constraint(cfg),
+                )
+                self.fleet.add_tenant(name, tcc, tier=tiers.get(name))
+                self._fleet_monitors.append(tmon)
+            if (
+                jdir
+                and self._replication is None
+                and self.replication_role == "writer"
+            ):
+                # the replicated read plane follows the DEFAULT tenant's WAL
+                # (the fleet-mode home of the pre-fleet controller namespace)
+                dflt = self.fleet.tenant("default").controller
+                if dflt.journal is not None:
+                    from cruise_control_tpu.replication import ReplicationState
+
+                    self._replication = ReplicationState(writer=True)
+                    dflt.journal.listener = self._replication.apply
+
         self.app = CruiseControlApp(
             self.cruise_control,
             anomaly_manager=self.anomaly_manager,
@@ -467,6 +553,7 @@ class CruiseControlTpuApp:
             readiness=self.readiness,
             user_task_journal=self._user_task_journal,
             controller=self.controller,
+            fleet=self.fleet,
             admission=self.admission,
             breaker=self.breaker,
             # max.active.user.tasks was defined but never wired pre-overload-
@@ -523,6 +610,21 @@ class CruiseControlTpuApp:
                 recovered = self.executor.recover()
             except Exception as e:
                 recovery_error = f"{type(e).__name__}: {e}"
+        def _seed_replication(s):
+            # seed the writer's replicated view with the recovered set:
+            # the journal listener only sees appends made from now on
+            # (the startup rewrite feeds it when compaction ran; this
+            # covers the already-compact WAL)
+            from cruise_control_tpu.executor.journal import proposal_to_record
+
+            self._replication.apply({
+                "type": "published", "version": s.version,
+                "created_ms": s.created_ms, "trigger": s.trigger,
+                "drift": s.drift, "reaction_s": s.reaction_s,
+                "epoch": s.epoch,
+                "proposals": [proposal_to_record(p) for p in s.proposals],
+            })
+
         controller_records = 0
         if self.controller is not None:
             # the standing proposal set rides the same recovery phase: a
@@ -537,20 +639,23 @@ class CruiseControlTpuApp:
                 and self.controller.standing is not None
                 and self._replication.set_version == 0
             ):
-                # seed the writer's replicated view with the recovered set:
-                # the journal listener only sees appends made from now on
-                # (the startup rewrite feeds it when compaction ran; this
-                # covers the already-compact WAL)
-                s = self.controller.standing
-                from cruise_control_tpu.executor.journal import proposal_to_record
-
-                self._replication.apply({
-                    "type": "published", "version": s.version,
-                    "created_ms": s.created_ms, "trigger": s.trigger,
-                    "drift": s.drift, "reaction_s": s.reaction_s,
-                    "epoch": s.epoch,
-                    "proposals": [proposal_to_record(p) for p in s.proposals],
-                })
+                _seed_replication(self.controller.standing)
+        if self.fleet is not None:
+            # every tenant's standing set rides the same recovery phase
+            # (fencing each tenant's epoch); the replicated read plane
+            # follows the default tenant
+            try:
+                controller_records += self.fleet.recover()
+            except Exception as e:
+                if recovery_error is None:
+                    recovery_error = f"{type(e).__name__}: {e}"
+            dflt = self.fleet.tenant("default").controller
+            if (
+                self._replication is not None
+                and dflt.standing is not None
+                and self._replication.set_version == 0
+            ):
+                _seed_replication(dflt.standing)
         if self._follower_tailer is not None:
             # the follower's recovery phase IS the first tail catch-up: one
             # synchronous poll so reads answer from the journaled set the
@@ -595,6 +700,13 @@ class CruiseControlTpuApp:
             now_ms = int(time.time() * 1000)
             span = (self.monitor.num_windows + 1) * self.monitor.window_ms
             self.monitor.bootstrap(now_ms - span, now_ms)
+        if self._fleet_monitors and self.config.get("demo.bootstrap.on.start"):
+            # extra fleet tenants are always demo-seeded: backfill their
+            # window rings too, so the fleet loop warms every lane at once
+            now_ms = int(time.time() * 1000)
+            for tmon in self._fleet_monitors:
+                span = (tmon.num_windows + 1) * tmon.window_ms
+                tmon.bootstrap(now_ms - span, now_ms)
 
         def _sampling_loop():
             while not self._stop.wait(interval_s):
@@ -602,6 +714,11 @@ class CruiseControlTpuApp:
                     self.monitor.sample_once()
                 except Exception:   # sampling must survive transient backend errors
                     pass
+                for tmon in self._fleet_monitors:
+                    try:
+                        tmon.sample_once()
+                    except Exception:
+                        pass
 
         self._sampling_thread = threading.Thread(target=_sampling_loop, daemon=True)
         self._sampling_thread.start()
@@ -609,6 +726,9 @@ class CruiseControlTpuApp:
             # the loop thread wakes on window deltas (and on cadence); it
             # warm-starts itself lazily once the monitor has a stable window
             self.controller.start()
+        if self.fleet is not None:
+            # same lazy-warm contract, one loop thread for every tenant
+            self.fleet.start()
         if self.replication_role == "writer":
             # the precompute refresher runs the solver — not follower work
             self.app.start_proposal_refresher()
@@ -619,6 +739,8 @@ class CruiseControlTpuApp:
             self._follower_tailer.stop()
         if self.controller is not None:
             self.controller.stop()   # seals the controller journal
+        if self.fleet is not None:
+            self.fleet.stop()        # seals every tenant's journal
         self.app.stop_proposal_refresher()
         if self._server is not None:
             self._server.shutdown()
@@ -646,6 +768,8 @@ class CruiseControlTpuApp:
             self._follower_tailer.stop()
         if self.controller is not None:
             self.controller.kill()   # loop thread down, journal un-sealed
+        if self.fleet is not None:
+            self.fleet.kill()        # loop down, tenant journals un-sealed
         self.app.stop_proposal_refresher()
         if self._server is not None:
             self._server.shutdown()

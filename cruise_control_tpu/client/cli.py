@@ -55,6 +55,19 @@ def build_parser() -> argparse.ArgumentParser:
     ct.add_argument("--reason", default="cctpu",
                     help="operator note recorded with pause/resume")
 
+    fl = sub.add_parser(
+        "fleet",
+        help="multi-tenant fleet controller: status (default), pause, "
+             "resume, or force one fleet tick (GET/POST /fleet); --tenant "
+             "narrows status to one tenant or flips/forces just its lane",
+    )
+    fl.add_argument("action", nargs="?", default="status",
+                    choices=["status", "pause", "resume", "tick"])
+    fl.add_argument("--tenant", default=None,
+                    help="tenant name (default: the whole fleet)")
+    fl.add_argument("--reason", default="cctpu",
+                    help="operator note recorded with pause/resume")
+
     wt = sub.add_parser(
         "watch",
         help="standing-proposal-set deltas via long-poll (GET /watch): "
@@ -191,6 +204,15 @@ def main(argv=None) -> int:
                 out = client.controller_resume(reason=args.reason)
             else:
                 out = client.controller_tick()
+        elif ep == "fleet":
+            if args.action == "status":
+                out = client.fleet_status(tenant=args.tenant)
+            elif args.action == "pause":
+                out = client.fleet_pause(reason=args.reason, tenant=args.tenant)
+            elif args.action == "resume":
+                out = client.fleet_resume(reason=args.reason, tenant=args.tenant)
+            else:
+                out = client.fleet_tick(tenant=args.tenant)
         elif ep == "watch":
             if args.follow:
                 for delta in client.watch_iter(

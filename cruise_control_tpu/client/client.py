@@ -215,6 +215,32 @@ class CruiseControlClient:
         evaluation instead of waiting for drift/cadence."""
         return self._post("controller", action="tick")
 
+    def fleet_status(self, tenant: Optional[str] = None) -> Any:
+        """GET /fleet: the fleet controller's status — coordinator state,
+        the last tick's batching census (tenants per dispatch, goal-order
+        groups), and one control-loop block per tenant.  ``tenant`` narrows
+        the answer to that tenant's block.  ``{"enabled": false}`` when
+        ``fleet.enable`` is off."""
+        return self._get("fleet", tenant=tenant)
+
+    def fleet_pause(
+        self, reason: str = "client request", tenant: Optional[str] = None
+    ) -> Any:
+        """POST /fleet?action=pause: stop the fleet (or one tenant's lane)
+        from ticking — every standing set keeps standing."""
+        return self._post("fleet", action="pause", reason=reason, tenant=tenant)
+
+    def fleet_resume(
+        self, reason: str = "client request", tenant: Optional[str] = None
+    ) -> Any:
+        return self._post("fleet", action="resume", reason=reason, tenant=tenant)
+
+    def fleet_tick(self, tenant: Optional[str] = None) -> Any:
+        """POST /fleet?action=tick: force one synchronous fleet evaluation;
+        with ``tenant`` only that tenant's lane is forced (the others still
+        ride the batched dispatch and trigger on their own drift)."""
+        return self._post("fleet", action="tick", tenant=tenant)
+
     def watch(self, since: int = 0, timeout_ms: int = 0) -> Any:
         """GET /watch: long-poll standing-proposal-set deltas (published /
         superseded / drained / epoch, keyed by version) since the ``since``

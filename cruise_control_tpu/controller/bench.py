@@ -64,12 +64,12 @@ CAPACITY = {
 }
 
 
-def build_harness(journal=None, config: ControllerConfig = None, wrap=None):
-    """(backend, monitor, controller, now_ms) with a warmed window ring.  The
-    controller is NOT warm-started — callers choose when to pay the compile
-    burst.  ``wrap`` (e.g. ``lambda b: ChaosBackend(b, plan)``) interposes on
-    the seeded backend before the monitor/facade see it — the chaos tests'
-    hook."""
+def build_cluster(wrap=None):
+    """(backend, monitor, cruise_control) for one pinned bench cluster —
+    shared by the single-tenant harness below and the fleet bench, whose
+    tenants each carry one of these.  ``wrap`` (e.g. ``lambda b:
+    ChaosBackend(b, plan)``) interposes on the seeded backend before the
+    monitor/facade see it — the chaos tests' hook."""
     backend = FakeClusterBackend()
     for b in range(BROKERS):
         backend.add_broker(b, rack=str(b % RACKS))
@@ -95,6 +95,22 @@ def build_harness(journal=None, config: ControllerConfig = None, wrap=None):
         goal_ids=GOALS,
         hard_ids=tuple(g for g in GOALS if g in G.HARD_GOALS),
     )
+    return backend, monitor, cc
+
+
+def warm_window_clock() -> int:
+    """A window-aligned start time: unaligned wall time would let a fixed
+    +10s offset cross a window boundary depending on WHEN the suite runs —
+    the window-accounting assertions must be run-time independent."""
+    now = int(time.time() * 1000)
+    return now - now % WINDOW_MS
+
+
+def build_harness(journal=None, config: ControllerConfig = None, wrap=None):
+    """(backend, monitor, controller, now_ms) with a warmed window ring.  The
+    controller is NOT warm-started — callers choose when to pay the compile
+    burst."""
+    backend, monitor, cc = build_cluster(wrap=wrap)
     controller = ContinuousController(
         cc,
         journal=journal,
@@ -105,11 +121,7 @@ def build_harness(journal=None, config: ControllerConfig = None, wrap=None):
         ),
     )
     monitor.add_window_listener(controller.on_window_delta)
-    # window-aligned clock: unaligned wall time would let a fixed +10s
-    # offset cross a window boundary depending on WHEN the suite runs —
-    # the window-accounting assertions must be run-time independent
-    now = int(time.time() * 1000)
-    now -= now % WINDOW_MS
+    now = warm_window_clock()
     for w in range(NUM_WINDOWS + 2):
         monitor.sample_once(now_ms=now + w * WINDOW_MS)
     return backend, monitor, controller, now + (NUM_WINDOWS + 2) * WINDOW_MS

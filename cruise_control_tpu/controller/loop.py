@@ -90,10 +90,20 @@ class ContinuousController:
         config: Optional[ControllerConfig] = None,
         breaker=None,
         clock=None,
+        tenant: Optional[str] = None,
     ) -> None:
         self.cc = cruise_control
         self.journal = journal
         self.cfg = config or ControllerConfig()
+        #: fleet membership: when set, every Controller.* sensor this instance
+        #: emits is re-namespaced to Fleet.* (fleet aggregate) and
+        #: Fleet.tenant.<name>.* (per-tenant series) — the global Controller.*
+        #: names keep meaning "the single-tenant loop" on mixed deployments
+        self.tenant = tenant
+        #: fleet seam: the fleet warms the BATCHED programs for the whole
+        #: stack; per-tenant single-lane warming would compile programs no
+        #: fleet tick ever runs
+        self.warm_programs_enabled = True
         #: monotonic time source; injectable so the replay harness
         #: (traces/replay.py) can drive staleness, cadence and reaction
         #: latency on a fake clock without sleeping
@@ -159,6 +169,42 @@ class ContinuousController:
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+        # host-numpy mirrors of the tracked/candidate device states.  Free to
+        # maintain: warm_start, the delta ingest and placement adoption all
+        # compute their numpy leaves BEFORE device_put anyway.  The fleet
+        # stacks these mirrors with np.stack (zero eager device dispatches)
+        # and feeds the batched programs through the jit boundary.
+        self._state_host = None
+        self._candidate_host = None
+
+    # -- sensor routing -------------------------------------------------------
+
+    def _sensor_names(self, name: str) -> List[str]:
+        """Route a Controller.* sensor constant: standalone keeps the global
+        name; a fleet tenant reports the fleet aggregate + its own series."""
+        if self.tenant is None:
+            return [name]
+        suffix = name.split(".", 1)[1]
+        return [f"Fleet.{suffix}", f"Fleet.tenant.{self.tenant}.{suffix}"]
+
+    def _count(self, name: str) -> None:
+        from cruise_control_tpu.core.sensors import REGISTRY
+
+        for s in self._sensor_names(name):
+            REGISTRY.counter(s).inc()
+
+    def _gauge(self, name: str, value) -> None:
+        from cruise_control_tpu.core.sensors import REGISTRY
+
+        for s in self._sensor_names(name):
+            REGISTRY.gauge(s).set(value)
+
+    def _timer(self, name: str, value) -> None:
+        from cruise_control_tpu.core.sensors import REGISTRY
+
+        for s in self._sensor_names(name):
+            REGISTRY.timer(s).update(value)
+
     # -- event surface (called from the monitor's sampling thread) -----------
 
     def on_window_delta(self, delta: WindowDelta) -> None:
@@ -204,7 +250,6 @@ class ContinuousController:
     def _loop(self) -> None:
         from cruise_control_tpu.core.sensors import (
             CONTROLLER_TICK_ERRORS_COUNTER,
-            REGISTRY,
         )
 
         while not self._stop.is_set():
@@ -217,7 +262,7 @@ class ContinuousController:
             except Exception:
                 # the loop survives everything — a dead control loop is a
                 # silent outage, the one failure mode this plane must not have
-                REGISTRY.counter(CONTROLLER_TICK_ERRORS_COUNTER).inc()
+                self._count(CONTROLLER_TICK_ERRORS_COUNTER)
 
     def pause(self, reason: str = "operator request") -> None:
         self.paused = True
@@ -234,7 +279,6 @@ class ContinuousController:
         from cruise_control_tpu.core.sensors import (
             CONTROLLER_STANDING_PROPOSALS_GAUGE,
             CONTROLLER_STANDING_VERSION_GAUGE,
-            REGISTRY,
             REPLICATION_EPOCH_GAUGE,
         )
 
@@ -264,11 +308,11 @@ class ContinuousController:
             pass
         except Exception:
             pass
-        REGISTRY.gauge(REPLICATION_EPOCH_GAUGE).set(self.journal.epoch)
+        self._gauge(REPLICATION_EPOCH_GAUGE, self.journal.epoch)
         if standing is not None:
-            REGISTRY.gauge(CONTROLLER_STANDING_VERSION_GAUGE).set(standing.version)
-            REGISTRY.gauge(CONTROLLER_STANDING_PROPOSALS_GAUGE).set(
-                len(standing.proposals)
+            self._gauge(CONTROLLER_STANDING_VERSION_GAUGE, standing.version)
+            self._gauge(
+                CONTROLLER_STANDING_PROPOSALS_GAUGE, len(standing.proposals)
             )
         return records
 
@@ -296,6 +340,9 @@ class ContinuousController:
         if bucket != B:
             state = A.pad_brokers(state, bucket)
             ctx = pad_context_brokers(ctx, bucket)
+        # host mirror first: the pre-device_put pytree IS the mirror (one
+        # device_get normalizes any jnp leaves to numpy; cold path, runs once)
+        self._state_host = jax.device_get(state)
         self._state = jax.device_put(state)
         self._ctx = ctx
         self._maps = maps
@@ -314,6 +361,7 @@ class ContinuousController:
         self._part_base[self._rp_np[live]] = base[live]
 
         self._candidate_state = None
+        self._candidate_host = None
         self._solved_viol = None
         # deltas ingested while cold (warmup sampling, compile burst) are not
         # load shifts the loop could have reacted to — the reaction clock
@@ -321,7 +369,8 @@ class ContinuousController:
         self._shift_t0 = None
         self._needs_rebuild = False
         self.warmed = True
-        self.warm_programs()
+        if self.warm_programs_enabled:
+            self.warm_programs()
 
     def warm_programs(self) -> None:
         """Pre-compile every program a tick can touch, once per shape
@@ -392,13 +441,21 @@ class ContinuousController:
         # never change — ONE pair of refreshed leaves serves both the tracked
         # state and the candidate (their placements differ, their loads don't)
         base_dev = jax.device_put(base)
-        delta_dev = jax.device_put(self._part_delta.copy())
+        delta_np = self._part_delta.copy()
+        delta_dev = jax.device_put(delta_np)
         self._state = self._state.replace(
             base_load=base_dev, leadership_delta=delta_dev
+        )
+        self._state_host = self._state_host.replace(
+            base_load=base, leadership_delta=delta_np
         )
         if self._candidate_state is not None:
             self._candidate_state = self._candidate_state.replace(
                 base_load=base_dev, leadership_delta=delta_dev
+            )
+        if self._candidate_host is not None:
+            self._candidate_host = self._candidate_host.replace(
+                base_load=base, leadership_delta=delta_np
             )
         return refreshed
 
@@ -406,7 +463,8 @@ class ContinuousController:
         """The executor drained the standing set cleanly: the candidate
         placement IS reality now — advance the tracked state to it (a fresh
         snapshot: every replica is original again)."""
-        rb = jax.device_put(np.asarray(final_host.replica_broker))
+        rb_np = np.asarray(final_host.replica_broker)
+        rb = jax.device_put(rb_np)
         self._state = self._state.replace(
             replica_broker=rb,
             replica_disk=jax.device_put(np.asarray(final_host.replica_disk)),
@@ -415,7 +473,14 @@ class ContinuousController:
             ),
             original_broker=rb,
         )
+        self._state_host = self._state_host.replace(
+            replica_broker=rb_np,
+            replica_disk=np.asarray(final_host.replica_disk),
+            partition_leader=np.asarray(final_host.partition_leader),
+            original_broker=rb_np,
+        )
         self._candidate_state = None   # candidate IS the tracked state now
+        self._candidate_host = None
 
     # -- the tick ------------------------------------------------------------
 
@@ -442,10 +507,9 @@ class ContinuousController:
                 # the drift baseline
                 from cruise_control_tpu.core.sensors import (
                     CONTROLLER_BREAKER_SKIPS_COUNTER,
-                    REGISTRY,
                 )
 
-                REGISTRY.counter(CONTROLLER_BREAKER_SKIPS_COUNTER).inc()
+                self._count(CONTROLLER_BREAKER_SKIPS_COUNTER)
                 return None
             if self.paused:
                 return None
@@ -456,27 +520,26 @@ class ContinuousController:
                     return None   # monitor still warming; next delta retries
             return self._evaluate_and_tick(force)
 
-    def _evaluate_and_tick(self, force: bool) -> Optional[StandingProposalSet]:
-        from cruise_control_tpu.core.sensors import (
-            CONTROLLER_BALANCEDNESS_GAUGE,
-            CONTROLLER_DRIFT_GAUGE,
-            CONTROLLER_IDLE_TICKS_COUNTER,
-            CONTROLLER_REBUILDS_COUNTER,
-            CONTROLLER_TICKS_COUNTER,
-            REGISTRY,
-        )
-        from cruise_control_tpu.obs import recorder as obs
+    # -- tick phases ----------------------------------------------------------
+    #
+    # `_evaluate_and_tick` below composes these for the single-tenant loop;
+    # the fleet controller (fleet/controller.py) drives the SAME phase
+    # methods per tenant — consuming evidence, ingesting, deciding triggers
+    # and committing publishes through identical code paths — while replacing
+    # only the device work in the middle (per-tenant probe/optimize dispatches
+    # become one batched dispatch per fleet tick).  None of the phase methods
+    # starts or finishes a trace: the driver owns the trace and the spans
+    # list, so a fleet tick is ONE "fleet_tick" flight record, not N nested
+    # controller_tick records.
 
-        token = obs.start_trace("controller_tick")
-        spans: List[obs.Span] = []
+    def tick_begin_evidence(self) -> Tuple[bool, Optional[float], Callable]:
+        """Consume the pending window delta and the reaction anchor.
 
-        # -- ingest: refresh the load leaves in place -------------------------
-        # the reaction anchor is consumed WITH the evidence: a delta landing
-        # mid-solve re-anchors a fresh clock instead of being wiped by the
-        # solve's completion (its reaction is measured by the NEXT tick).
-        # A skipped/refused tick restores the anchor — unanswered evidence
-        # keeps its clock running.
-        t0 = time.monotonic()
+        The anchor is consumed WITH the evidence: a delta landing mid-solve
+        re-anchors a fresh clock instead of being wiped by the solve's
+        completion (its reaction is measured by the NEXT tick).  The returned
+        restore callback re-arms the anchor when the tick is skipped or the
+        publish is refused — unanswered evidence keeps its clock running."""
         had_delta = self._pending_delta
         self._pending_delta = False
         anchor = self._shift_t0
@@ -485,6 +548,16 @@ class ContinuousController:
         def _restore_anchor() -> None:
             if anchor is not None and self._shift_t0 is None:
                 self._shift_t0 = anchor
+
+        return had_delta, anchor, _restore_anchor
+
+    def tick_ingest(self, had_delta: bool) -> Tuple[int, Optional[str]]:
+        """Refresh the load leaves in place; rebuild on topology change.
+
+        Returns ``(partitions_refreshed, error)`` — a non-None error means
+        the rebuild failed (flagged for the next wake; the caller restores
+        the anchor and closes its trace)."""
+        from cruise_control_tpu.core.sensors import CONTROLLER_REBUILDS_COUNTER
 
         refreshed = 0
         if had_delta:
@@ -496,7 +569,7 @@ class ContinuousController:
                 # rebuild (counted — this is the expensive path the delta
                 # ingest exists to avoid), standing set invalidated (its
                 # old_replicas may no longer describe reality)
-                REGISTRY.counter(CONTROLLER_REBUILDS_COUNTER).inc()
+                self._count(CONTROLLER_REBUILDS_COUNTER)
                 if self.standing is not None and self.journal is not None:
                     self.journal.invalidated(
                         self.standing.version, "topology-changed"
@@ -508,51 +581,48 @@ class ContinuousController:
                 except Exception as e:
                     # the monitor can be momentarily incomplete mid-change;
                     # flag the rebuild for the next wake instead of dying
-                    # with an unfinished trace
                     self._needs_rebuild = True
-                    _restore_anchor()
-                    obs.finish_trace(
-                        token, spans=spans,
-                        attrs={"skipped": True, "error": f"rebuild failed: {e}"},
-                    )
-                    return None
+                    return refreshed, f"rebuild failed: {e}"
                 refreshed = self._ingest_loads()
-        spans.append(
-            obs.Span(
-                "ingest", "ingest", time.monotonic() - t0, 0,
-                attrs={"partitions_refreshed": max(refreshed, 0)},
-            )
-        )
+        return refreshed, None
 
-        # -- drift: one compiled dispatch + host math -------------------------
-        # probed on the CANDIDATE state (last solve's output placement, live
-        # loads) when a standing set exists: violations there are the ones
-        # the standing set does NOT answer.  No candidate = probe the
-        # tracked state (everything unanswered).
-        t0 = time.monotonic()
-        probe_state = (
+    def tick_probe_state(self):
+        """The device state drift is measured on: the CANDIDATE (last solve's
+        output placement, live loads) when a standing set exists — violations
+        there are the ones the standing set does NOT answer — else the
+        tracked state (everything unanswered)."""
+        return (
             self._candidate_state
             if self._candidate_state is not None
             else self._state
         )
-        viol_now = np.asarray(self._optimizer.violations(probe_state, self._ctx))
+
+    def tick_probe_host(self):
+        """Host-mirror twin of :meth:`tick_probe_state` — what the fleet
+        stacks into its batched probe."""
+        return (
+            self._candidate_host
+            if self._candidate_host is not None
+            else self._state_host
+        )
+
+    def tick_decide(
+        self, viol_now, force: bool
+    ) -> Tuple[DriftReport, Optional[str], bool]:
+        """Host-side drift math + trigger decision from a probed violation
+        vector.  Returns ``(report, trigger, stale)``; trigger None = skip."""
+        from cruise_control_tpu.core.sensors import (
+            CONTROLLER_BALANCEDNESS_GAUGE,
+            CONTROLLER_DRIFT_GAUGE,
+        )
+
         report = evaluate_drift(
             viol_now, self._solved_viol,
             self._optimizer.goal_ids, self._optimizer.hard_ids,
         )
         self._last_drift = report
-        REGISTRY.gauge(CONTROLLER_DRIFT_GAUGE).set(report.score)
-        REGISTRY.gauge(CONTROLLER_BALANCEDNESS_GAUGE).set(report.balancedness)
-        spans.append(
-            obs.Span(
-                "drift", "drift", time.monotonic() - t0, 1,
-                attrs={
-                    "score": report.score,
-                    "hard_score": report.hard_score,
-                    "violated_goals": report.violated_goals,
-                },
-            )
-        )
+        self._gauge(CONTROLLER_DRIFT_GAUGE, report.score)
+        self._gauge(CONTROLLER_BALANCEDNESS_GAUGE, report.balancedness)
 
         now = self._clock()
         cadence_due = (now - self._last_solve_mono) >= self.cfg.tick_interval_s
@@ -571,71 +641,44 @@ class ContinuousController:
             trigger = "cadence"
         else:
             trigger = None
-        if trigger is None:
-            REGISTRY.counter(CONTROLLER_IDLE_TICKS_COUNTER).inc()
-            _restore_anchor()
-            standing = self.standing
-            obs.finish_trace(
-                token, spans=spans,
-                attrs={
-                    "skipped": True,
-                    "stale": stale,
-                    "drift": report.score,
-                    "balancedness": report.balancedness,
-                    "standing_version": (
-                        standing.version if standing else None
-                    ),
-                },
-            )
-            return None
+        return report, trigger, stale
 
-        published = self._tick(
-            token, spans, viol_now, report, trigger, anchor, _restore_anchor
+    def tick_skipped(self) -> None:
+        """Idle-tick accounting for a trigger-None evaluation."""
+        from cruise_control_tpu.core.sensors import (
+            CONTROLLER_IDLE_TICKS_COUNTER,
         )
-        REGISTRY.counter(CONTROLLER_TICKS_COUNTER).inc()
-        return published
 
-    def _tick(
-        self, token, spans, viol_now, report: DriftReport, trigger: str,
-        anchor: Optional[float], restore_anchor,
-    ) -> Optional[StandingProposalSet]:
+        self._count(CONTROLLER_IDLE_TICKS_COUNTER)
+
+    def tick_commit(
+        self,
+        spans,
+        report: DriftReport,
+        trigger: str,
+        anchor: Optional[float],
+        restore_anchor,
+        initial_host,
+        final_host,
+        inc,
+        final_dev=None,
+    ) -> Tuple[Optional[StandingProposalSet], dict]:
+        """Publish phase: diff → versioned standing set → write-ahead journal
+        → supersede → baselines → optional drain.  Appends the publish span
+        to ``spans`` and returns ``(published, attrs)`` WITHOUT finishing any
+        trace — the driver owns trace lifecycle.  ``final_dev``, when the
+        caller already holds the solve output on device, seeds the candidate
+        state without a host→device transfer."""
         from cruise_control_tpu.core.sensors import (
             CONTROLLER_PUBLISHED_COUNTER,
             CONTROLLER_REACTION_TIMER,
             CONTROLLER_STANDING_PROPOSALS_GAUGE,
             CONTROLLER_STANDING_VERSION_GAUGE,
             CONTROLLER_TICK_ERRORS_COUNTER,
-            REGISTRY,
+            CONTROLLER_TICKS_COUNTER,
         )
         from cruise_control_tpu.obs import recorder as obs
 
-        # -- bounded incremental optimize from the CURRENT placement ----------
-        # viol_now was probed on the candidate when one exists; the optimize
-        # starts from the TRACKED placement, whose violation set can be a
-        # superset (it still carries what the standing set was fixing) — let
-        # incremental_optimize re-probe it (one extra dispatch) in that case
-        t0 = time.monotonic()
-        initial_host = jax.device_get(self._state)
-        final, inc = self._optimizer.incremental_optimize(
-            self._state, self._ctx,
-            max_rounds=self.cfg.max_rounds_per_tick,
-            violations=viol_now if self._candidate_state is None else None,
-        )
-        final_host = jax.device_get(final)
-        spans.append(
-            obs.Span(
-                "optimize", "optimize", time.monotonic() - t0,
-                inc.num_dispatches,
-                attrs={
-                    "goals_run": inc.goals_run,
-                    "moves": inc.total_moves,
-                    "rounds": inc.total_rounds,
-                    "max_rounds_per_tick": self.cfg.max_rounds_per_tick,
-                },
-            )
-        )
-
-        # -- publish the versioned standing set (write-ahead) -----------------
         t0 = time.monotonic()
         proposals = diff_proposals(initial_host, final_host, self._maps)
         reaction_s: Optional[float] = None
@@ -678,24 +721,24 @@ class ContinuousController:
                         self.journal.rewrite(candidate)
                     except Exception:
                         pass
-                REGISTRY.counter(CONTROLLER_PUBLISHED_COUNTER).inc()
-                REGISTRY.gauge(CONTROLLER_STANDING_VERSION_GAUGE).set(
-                    candidate.version
-                )
-                REGISTRY.gauge(CONTROLLER_STANDING_PROPOSALS_GAUGE).set(
-                    len(proposals)
-                )
+                self._count(CONTROLLER_PUBLISHED_COUNTER)
+                self._gauge(CONTROLLER_STANDING_VERSION_GAUGE, candidate.version)
+                self._gauge(CONTROLLER_STANDING_PROPOSALS_GAUGE, len(proposals))
                 if reaction_s is not None:
-                    REGISTRY.timer(CONTROLLER_REACTION_TIMER).update(reaction_s)
+                    self._timer(CONTROLLER_REACTION_TIMER, reaction_s)
             except Exception as e:
                 publish_error = f"{type(e).__name__}: {e}"
-                REGISTRY.counter(CONTROLLER_TICK_ERRORS_COUNTER).inc()
+                self._count(CONTROLLER_TICK_ERRORS_COUNTER)
                 # the evidence was NOT answered: its reaction clock resumes
                 restore_anchor()
         spans.append(
             obs.Span(
                 "publish", "publish", time.monotonic() - t0, 0,
-                attrs={"proposals": len(proposals), "error": publish_error},
+                attrs={
+                    "proposals": len(proposals),
+                    "error": publish_error,
+                    **({"tenant": self.tenant} if self.tenant else {}),
+                },
             )
         )
 
@@ -707,7 +750,11 @@ class ContinuousController:
         # the next wake retries against the old baseline.
         if publish_error is None:
             if published is not None:
-                self._candidate_state = final
+                self._candidate_state = (
+                    final_dev if final_dev is not None
+                    else jax.device_put(final_host)
+                )
+                self._candidate_host = jax.device_get(final_host)
             self._solved_viol = inc.violations_after
             self._last_solve_mono = self._clock()
 
@@ -730,7 +777,113 @@ class ContinuousController:
             "drained": drained,
             "error": publish_error,
         }
+        if self.tenant is not None:
+            attrs["tenant"] = self.tenant
         self._last_tick_attrs = attrs
+        self._count(CONTROLLER_TICKS_COUNTER)
+        return published, attrs
+
+    # -- the single-tenant driver --------------------------------------------
+
+    def _evaluate_and_tick(self, force: bool) -> Optional[StandingProposalSet]:
+        from cruise_control_tpu.obs import recorder as obs
+
+        token = obs.start_trace("controller_tick")
+        spans: List[obs.Span] = []
+
+        # -- ingest: refresh the load leaves in place -------------------------
+        t0 = time.monotonic()
+        had_delta, anchor, _restore_anchor = self.tick_begin_evidence()
+        refreshed, ingest_error = self.tick_ingest(had_delta)
+        if ingest_error is not None:
+            _restore_anchor()
+            obs.finish_trace(
+                token, spans=spans,
+                attrs={"skipped": True, "error": ingest_error},
+            )
+            return None
+        spans.append(
+            obs.Span(
+                "ingest", "ingest", time.monotonic() - t0, 0,
+                attrs={"partitions_refreshed": max(refreshed, 0)},
+            )
+        )
+
+        # -- drift: one compiled dispatch + host math -------------------------
+        t0 = time.monotonic()
+        viol_now = np.asarray(
+            self._optimizer.violations(self.tick_probe_state(), self._ctx)
+        )
+        report, trigger, stale = self.tick_decide(viol_now, force)
+        spans.append(
+            obs.Span(
+                "drift", "drift", time.monotonic() - t0, 1,
+                attrs={
+                    "score": report.score,
+                    "hard_score": report.hard_score,
+                    "violated_goals": report.violated_goals,
+                },
+            )
+        )
+
+        if trigger is None:
+            self.tick_skipped()
+            _restore_anchor()
+            standing = self.standing
+            obs.finish_trace(
+                token, spans=spans,
+                attrs={
+                    "skipped": True,
+                    "stale": stale,
+                    "drift": report.score,
+                    "balancedness": report.balancedness,
+                    "standing_version": (
+                        standing.version if standing else None
+                    ),
+                },
+            )
+            return None
+
+        return self._tick(
+            token, spans, viol_now, report, trigger, anchor, _restore_anchor
+        )
+
+    def _tick(
+        self, token, spans, viol_now, report: DriftReport, trigger: str,
+        anchor: Optional[float], restore_anchor,
+    ) -> Optional[StandingProposalSet]:
+        from cruise_control_tpu.obs import recorder as obs
+
+        # -- bounded incremental optimize from the CURRENT placement ----------
+        # viol_now was probed on the candidate when one exists; the optimize
+        # starts from the TRACKED placement, whose violation set can be a
+        # superset (it still carries what the standing set was fixing) — let
+        # incremental_optimize re-probe it (one extra dispatch) in that case
+        t0 = time.monotonic()
+        initial_host = jax.device_get(self._state)
+        final, inc = self._optimizer.incremental_optimize(
+            self._state, self._ctx,
+            max_rounds=self.cfg.max_rounds_per_tick,
+            violations=viol_now if self._candidate_state is None else None,
+        )
+        final_host = jax.device_get(final)
+        spans.append(
+            obs.Span(
+                "optimize", "optimize", time.monotonic() - t0,
+                inc.num_dispatches,
+                attrs={
+                    "goals_run": inc.goals_run,
+                    "moves": inc.total_moves,
+                    "rounds": inc.total_rounds,
+                    "max_rounds_per_tick": self.cfg.max_rounds_per_tick,
+                },
+            )
+        )
+
+        published, attrs = self.tick_commit(
+            spans, report, trigger, anchor, restore_anchor,
+            initial_host, final_host, inc, final_dev=final,
+        )
         obs.finish_trace(token, spans=spans, attrs=attrs)
         return published
 
@@ -741,7 +894,6 @@ class ContinuousController:
         from cruise_control_tpu.core.sensors import (
             CONTROLLER_DRAINED_COUNTER,
             CONTROLLER_STANDING_PROPOSALS_GAUGE,
-            REGISTRY,
         )
         from cruise_control_tpu.executor.engine import OngoingExecutionError
 
@@ -760,8 +912,8 @@ class ContinuousController:
         if self.journal is not None:
             self.journal.drained(standing.version, summary)
         self.standing = None
-        REGISTRY.counter(CONTROLLER_DRAINED_COUNTER).inc()
-        REGISTRY.gauge(CONTROLLER_STANDING_PROPOSALS_GAUGE).set(0)
+        self._count(CONTROLLER_DRAINED_COUNTER)
+        self._gauge(CONTROLLER_STANDING_PROPOSALS_GAUGE, 0)
         if summary.succeeded:
             self._adopt_placement(final_host)
         else:
@@ -777,12 +929,9 @@ class ContinuousController:
         return max(self._clock() - anchor, 0.0)
 
     def _update_staleness_gauge(self) -> None:
-        from cruise_control_tpu.core.sensors import (
-            CONTROLLER_STALENESS_GAUGE,
-            REGISTRY,
-        )
+        from cruise_control_tpu.core.sensors import CONTROLLER_STALENESS_GAUGE
 
-        REGISTRY.gauge(CONTROLLER_STALENESS_GAUGE).set(self._staleness_s())
+        self._gauge(CONTROLLER_STALENESS_GAUGE, self._staleness_s())
 
     def status(self) -> Dict[str, object]:
         """The CONTROLLER endpoint / STATE block payload."""
@@ -793,7 +942,10 @@ class ContinuousController:
 
         self._update_staleness_gauge()
         staleness = self._staleness_s()
-        reaction = REGISTRY.timer(CONTROLLER_REACTION_TIMER).snapshot()
+        # a fleet tenant reads ITS reaction series, not the global one
+        reaction = REGISTRY.timer(
+            self._sensor_names(CONTROLLER_REACTION_TIMER)[-1]
+        ).snapshot()
         drift = self._last_drift
         # capture once: the tick/drain thread swaps these without a lock
         # shared with the HTTP handler

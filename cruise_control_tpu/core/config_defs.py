@@ -247,6 +247,57 @@ def controller_config() -> ConfigDef:
     return d
 
 
+def fleet_config() -> ConfigDef:
+    """Multi-tenant fleet controller (fleet/ — TPU-specific, no reference
+    counterpart): N tenant clusters optimized together through one batched
+    control plane."""
+    d = ConfigDef()
+    d.define("fleet.enable", Type.BOOLEAN, False, H,
+             "Run the fleet controller instead of the single-tenant "
+             "continuous controller: every tenant cluster keeps its own "
+             "warm state, standing proposal set and journal namespace "
+             "(journal.dir/<tenant>), while drift probes and incremental "
+             "re-optimizes are batched across tenants into one vmapped "
+             "dispatch per goal-order group.  The app's primary cluster "
+             "becomes the 'default' tenant (adopting a pre-fleet "
+             "journal.dir/controller WAL on first startup).")
+    d.define("fleet.tenants", Type.LIST, "", M,
+             "Extra tenant names to host beside 'default'; each gets its "
+             "own demo-seeded cluster backend and monitor (a real "
+             "deployment registers tenants programmatically via "
+             "FleetController.add_tenant).")
+    d.define("fleet.tick.interval.ms", Type.LONG, 30_000, M,
+             "Cadence of the fleet loop: one evaluation covers every "
+             "tenant.", in_range(lo=1))
+    d.define("fleet.drift.threshold", Type.DOUBLE, 1.0, M,
+             "Per-tenant violation-count drift that triggers that tenant's "
+             "lane ahead of the cadence.", in_range(lo=0.0))
+    d.define("fleet.max.rounds.per.tick", Type.INT, 64, M,
+             "Round cap per goal phase of the batched incremental "
+             "re-optimize (shared by every lane of a group).", in_range(lo=1))
+    d.define("fleet.stale.after.ms", Type.LONG, 300_000, L,
+             "Per-tenant staleness horizon (same semantics as "
+             "controller.stale.after.ms, applied per tenant).", in_range(lo=1))
+    d.define("fleet.execute.enable", Type.BOOLEAN, False, M,
+             "Let the fleet drain published standing sets to the tenants' "
+             "executors, under the cross-tenant arbitration below.  Tenant "
+             "loops never drain on their own.")
+    d.define("fleet.max.concurrent.drains", Type.INT, 1, M,
+             "Cross-tenant capacity arbitration: standing sets granted a "
+             "drain per fleet tick; the rest stay published and are "
+             "superseded or drained on a later tick.", in_range(lo=1))
+    d.define("fleet.drain.stagger.ms", Type.LONG, 0, L,
+             "Staggered execution windows: minimum milliseconds between "
+             "two drains of the same tenant (0 = no stagger).",
+             in_range(lo=0))
+    d.define("fleet.tenant.tiers", Type.STRING, "", M,
+             "Tenant admission tiers as 'name:tier,...' (lower tier = "
+             "served first within an endpoint class).  Threads each tenant "
+             "principal's requests through the admission queue at its "
+             "tier, so one noisy tenant cannot starve the fleet.")
+    return d
+
+
 def admission_config() -> ConfigDef:
     """Overload-resilient serving plane (api/admission.py + backend/breaker.py
     — TPU-specific, no reference counterpart): admission control, per-principal
@@ -424,6 +475,7 @@ def cruise_control_config() -> ConfigDef:
         analyzer_config(),
         executor_config(),
         controller_config(),
+        fleet_config(),
         admission_config(),
         anomaly_detector_config(),
         webserver_config(),

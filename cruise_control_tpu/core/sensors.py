@@ -228,6 +228,21 @@ CONTROLLER_STANDING_PROPOSALS_GAUGE = "Controller.standing-proposals"
 CONTROLLER_STALENESS_GAUGE = "Controller.staleness-seconds"
 CONTROLLER_REBUILDS_COUNTER = "Controller.topology-rebuilds"
 CONTROLLER_BREAKER_SKIPS_COUNTER = "Controller.breaker-open-skips"
+# fleet controller (fleet/controller.py): coordinator-level series.  Tenant
+# control loops re-namespace their Controller.* sensors to Fleet.<suffix>
+# (fleet aggregate) + Fleet.tenant.<name>.<suffix> (per-tenant series); the
+# Fleet.coordinator.* names below are the fleet tick machinery itself, so
+# they never collide with the aggregated suffixes
+FLEET_TICKS_COUNTER = "Fleet.coordinator.ticks"
+FLEET_TICK_ERRORS_COUNTER = "Fleet.coordinator.tick-errors"
+FLEET_TENANTS_GAUGE = "Fleet.coordinator.tenants"
+FLEET_GROUPS_GAUGE = "Fleet.coordinator.goal-order-groups"
+FLEET_PROBE_DISPATCHES_COUNTER = "Fleet.coordinator.probe-dispatches"
+FLEET_OPTIMIZE_DISPATCHES_COUNTER = "Fleet.coordinator.optimize-dispatches"
+FLEET_DRAINS_COUNTER = "Fleet.coordinator.drains-granted"
+FLEET_DRAIN_DEFERRALS_COUNTER = "Fleet.coordinator.drain-deferrals"
+FLEET_BREAKER_SKIPS_COUNTER = "Fleet.coordinator.breaker-open-skips"
+FLEET_MIGRATIONS_COUNTER = "Fleet.coordinator.legacy-namespaces-adopted"
 # overload plane (api/admission.py): every authenticated request passes the
 # admission controller — sheds are the load-shedding contract (429 +
 # Retry-After, never a 500), accounted by reason

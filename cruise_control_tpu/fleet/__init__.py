@@ -1,0 +1,15 @@
+"""Multi-tenant fleet controller: N clusters, one batched dispatch."""
+
+from cruise_control_tpu.fleet.controller import (
+    RESERVED_TENANT_NAMES,
+    FleetConfig,
+    FleetController,
+    adopt_legacy_namespace,
+)
+
+__all__ = [
+    "FleetConfig",
+    "FleetController",
+    "RESERVED_TENANT_NAMES",
+    "adopt_legacy_namespace",
+]

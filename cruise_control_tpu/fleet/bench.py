@@ -1,0 +1,170 @@
+"""Shared fleet-bench harness: warm fleet-tick dispatch/compile census.
+
+One measurement function serves three consumers — ``scripts/bench_fleet.py``
+(the committed ``benchmarks/BENCH_FLEET_cpu.json`` artifact + CI step), the
+``fleet`` tier of the regression gate (``obs/gate.py``), and the slow
+acceptance test — so the numbers the gate enforces are measured by exactly
+the code the bench committed.
+
+The workload: ``num_tenants`` copies of the pinned single-tenant bench
+cluster (``controller/bench.py`` — same brokers, partitions, goal list), all
+landing in ONE goal-order group.  After ``FleetController.warm()`` pays the
+batched compile burst, each measured shift pumps every tenant's tracked
+placement past the disk-capacity threshold so every tenant's lane is
+drift-triggered, then one fleet tick runs.
+
+Measured per shift, from the ``fleet_tick`` flight record: the vmapped drift
+probe must be exactly ONE dispatch for the whole fleet, the grouped
+incremental optimize must fit ``len(GOALS) + 4`` dispatches (re-probe +
+union goals + trailing fetch, with the fleet-level probe), XLA compile
+events must be ZERO, and every triggered tenant must publish.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+from cruise_control_tpu.controller.bench import (
+    BASE_LOAD,
+    BROKERS,
+    GOALS,
+    HOT_DISK,
+    NUM_WINDOWS,
+    PARTITIONS,
+    SHIFTS,
+    WINDOW_MS,
+    build_cluster,
+    hot_partitions_on,
+    warm_window_clock,
+)
+from cruise_control_tpu.fleet.controller import FleetConfig, FleetController
+
+#: pinned fleet width — changing it requires --update-baseline
+NUM_TENANTS = 32
+
+
+def build_fleet_harness(
+    num_tenants: int = NUM_TENANTS,
+    journal_dir: str = None,
+    config: FleetConfig = None,
+):
+    """(fleet, backends, monitors, now_ms): ``num_tenants`` identical pinned
+    clusters registered on one fleet, every monitor's window ring warmed.
+    The fleet is NOT warmed — callers choose when to pay the compile burst."""
+    fleet = FleetController(
+        config=config
+        or FleetConfig(
+            tick_interval_s=3_600.0,   # cadence off: drift is the trigger
+            drift_threshold=1.0,
+        ),
+        journal_dir=journal_dir,
+    )
+    backends: List = []
+    monitors: List = []
+    for t in range(num_tenants):
+        backend, monitor, cc = build_cluster()
+        fleet.add_tenant(f"tenant{t:02d}", cc)
+        backends.append(backend)
+        monitors.append(monitor)
+    now = warm_window_clock()
+    for w in range(NUM_WINDOWS + 2):
+        ts = now + w * WINDOW_MS
+        for monitor in monitors:
+            monitor.sample_once(now_ms=ts)
+    return fleet, backends, monitors, now + (NUM_WINDOWS + 2) * WINDOW_MS
+
+
+def run_bench(
+    num_tenants: int = NUM_TENANTS, shifts: int = SHIFTS
+) -> Dict[str, object]:
+    """The measurement record both the bench script and the gate tier gate."""
+    from cruise_control_tpu.obs import RECORDER
+
+    fleet, backends, monitors, now_ms = build_fleet_harness(num_tenants)
+
+    t0 = time.monotonic()
+    fleet.warm()   # warm_start per tenant + the batched compile burst
+    warm_s = time.monotonic() - t0
+
+    def _feed_shift(now: int) -> int:
+        """Two windows per shift: the shifted samples land in window w, the
+        second sample opens w+1 so w goes STABLE and every tenant's listener
+        pushes a delta carrying the shifted loads."""
+        now += WINDOW_MS
+        for monitor in monitors:
+            monitor.sample_once(now_ms=now)
+        now += WINDOW_MS
+        for monitor in monitors:
+            monitor.sample_once(now_ms=now)
+        return now
+
+    def _pump(victim: int, prev: List[List]) -> List[List]:
+        """Overload ``victim``'s tracked partitions on EVERY tenant (and cool
+        the previous victims): every lane of the group drift-triggers."""
+        hots = []
+        for t, backend in enumerate(backends):
+            for tp in prev[t] if prev else []:
+                backend.set_partition_load(tp, list(BASE_LOAD))
+            rt = fleet.tenant(fleet.tenant_names[t])
+            hot = hot_partitions_on(rt.controller, victim)
+            for tp in hot:
+                backend.set_partition_load(tp, [0.2, 50.0, 50.0, HOT_DISK])
+            hots.append(hot)
+        return hots
+
+    # one unmeasured shift settles initial placements + drift baselines
+    prev_hot = _pump(0, [])
+    now_ms = _feed_shift(now_ms)
+    fleet.maybe_tick()
+
+    tick_walls: List[float] = []
+    dispatches: List[int] = []
+    probe_dispatches: List[int] = []
+    compiles = 0
+    published = 0
+    groups_seen = set()
+    for k in range(shifts):
+        prev_hot = _pump((k + 1) % BROKERS, prev_hot)
+        now_ms = _feed_shift(now_ms)
+        tw = time.monotonic()
+        attrs = fleet.maybe_tick()
+        tick_walls.append(time.monotonic() - tw)
+        trace = next(iter(RECORDER.recent(1, kind="fleet_tick")), None)
+        if attrs is not None:
+            published += int(attrs.get("published", 0))
+            dispatches.append(int(attrs.get("num_dispatches", 0)))
+            probe_dispatches.append(int(attrs.get("probe_dispatches", 0)))
+            groups_seen.add(int(attrs.get("groups", 0)))
+        if trace is not None:
+            compiles += len(trace.compile_events)
+
+    tick_walls.sort()
+
+    def pct(vals: List[float], q: float) -> float:
+        if not vals:
+            return 0.0
+        return vals[min(int(q * len(vals)), len(vals) - 1)]
+
+    return {
+        "num_tenants": num_tenants,
+        "shifts": shifts,
+        "published": published,
+        "groups": max(groups_seen) if groups_seen else 0,
+        # identical tenants ⇒ ONE goal-order group ⇒ ONE vmapped probe per tick
+        "warm_probe_dispatches": max(probe_dispatches) if probe_dispatches else 0,
+        # probe + (re-probe + union goals + trailing fetch) for the one group
+        "warm_tick_dispatches": max(dispatches) if dispatches else 0,
+        "dispatch_budget": len(GOALS) + 4,
+        "warm_compile_events": compiles,
+        "tenants_per_dispatch": (
+            round(num_tenants / max(probe_dispatches), 2)
+            if probe_dispatches and max(probe_dispatches)
+            else 0.0
+        ),
+        "tick_wall_p50_s": round(pct(tick_walls, 0.50), 4),
+        "tick_wall_p95_s": round(pct(tick_walls, 0.95), 4),
+        "warm_s": round(warm_s, 3),
+        "brokers": BROKERS,
+        "partitions": PARTITIONS,
+    }

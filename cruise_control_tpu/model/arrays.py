@@ -338,21 +338,72 @@ def unpad_brokers(
     )
 
 
-def stack_arrays(per: Sequence[ClusterArrays]) -> ClusterArrays:
+def stack_arrays(
+    per: Sequence[ClusterArrays],
+    goal_orders: Optional[Sequence[Sequence[int]]] = None,
+) -> ClusterArrays:
     """Stack same-shape states leaf-wise into one batched ``ClusterArrays``.
 
     Every array leaf gains a leading scenario axis of size ``len(per)``;
     static metadata (rack/topic/host counts) is shared — the stacked pytree is
-    a valid ``jax.vmap`` operand (the CvxCluster batch-allocation layout)."""
+    a valid ``jax.vmap`` operand (the CvxCluster batch-allocation layout).
+
+    ``goal_orders``, when given, carries the goal order each state will be
+    optimized under (one sequence per state).  A batched goal walk runs ONE
+    static goal sequence across every lane, so states destined for different
+    orders must never share a stack — callers (``sim.deep_sweep``,
+    ``fleet``) group by goal order first, and this guard turns a mis-grouped
+    batch into a loud error instead of a silently wrong walk.
+
+    Leaves are stacked with numpy when every input leaf is host-resident
+    (the fleet's host-mirror path: zero eager device dispatches, the jit
+    boundary transfers once), with ``jnp.stack`` otherwise.
+    """
+    import numpy as np
+
     if not per:
         raise ValueError("stack_arrays needs at least one state")
+    if goal_orders is not None:
+        if len(goal_orders) != len(per):
+            raise ValueError(
+                f"stack_arrays: {len(per)} states but {len(goal_orders)} "
+                "goal orders — pass one goal order per state"
+            )
+        distinct = {tuple(int(g) for g in o) for o in goal_orders}
+        if len(distinct) > 1:
+            raise ValueError(
+                "stack_arrays: refusing to stack states with differing goal "
+                f"orders {sorted(distinct)} — a batched goal walk runs one "
+                "static goal sequence across all lanes; group states by goal "
+                "order first and stack each group separately"
+            )
     fields = {}
     for f in dataclasses.fields(ClusterArrays):
         v0 = getattr(per[0], f.name)
         if f.metadata.get("pytree_node", True) is False or isinstance(v0, int):
+            for k, p in enumerate(per):
+                if getattr(p, f.name) != v0:
+                    raise ValueError(
+                        f"stack_arrays: static field {f.name!r} differs "
+                        f"between state 0 ({v0!r}) and state {k} "
+                        f"({getattr(p, f.name)!r}) — only same-shape states "
+                        "share a batch"
+                    )
             fields[f.name] = v0
             continue
-        fields[f.name] = jnp.stack([getattr(p, f.name) for p in per])
+        leaves = [getattr(p, f.name) for p in per]
+        shape0 = np.shape(v0)
+        for k, leaf in enumerate(leaves):
+            if np.shape(leaf) != shape0:
+                raise ValueError(
+                    f"stack_arrays: leaf {f.name!r} shape mismatch — state 0 "
+                    f"has {shape0}, state {k} has {np.shape(leaf)}; pad to a "
+                    "common bucket before stacking"
+                )
+        if all(isinstance(x, np.ndarray) for x in leaves):
+            fields[f.name] = np.stack(leaves)
+        else:
+            fields[f.name] = jnp.stack(leaves)
     return ClusterArrays(**fields)
 
 

@@ -285,6 +285,64 @@ def _run_controller_tier(inject_sleep_s: float = 0.0) -> dict:
     }
 
 
+def _run_fleet_tier(inject_sleep_s: float = 0.0) -> dict:
+    """Fleet-controller tier: the batched multi-tenant dispatch contract.
+
+    Runs the SAME harness that commits ``benchmarks/BENCH_FLEET_cpu.json``
+    (``cruise_control_tpu/fleet/bench.py``): 32 identical tenant clusters on
+    one fleet, every tenant drift-triggered per shift.  Hard contracts —
+    the drift probe must be ONE vmapped dispatch for the whole fleet (one
+    goal-order group), the grouped incremental optimize must fit the
+    ``#goals + 4`` dispatch budget, ANY XLA compile event on a warm fleet
+    tick fails, and every triggered tenant must publish.  The gated wall is
+    the warm fleet-tick p50 (>25 % vs the committed artifact fails, see
+    ``_fleet_baseline``)."""
+    _force_cpu_platform()
+    from cruise_control_tpu.fleet import bench
+
+    m = bench.run_bench()
+    want_published = m["num_tenants"] * m["shifts"]
+    if m["published"] < want_published:
+        return {
+            "tier": "fleet",
+            "error": (
+                f"{m['published']} published sets < {want_published} "
+                f"({m['num_tenants']} tenants x {m['shifts']} shifts)"
+            ),
+        }
+    if m["groups"] != 1 or m["warm_probe_dispatches"] != 1:
+        return {
+            "tier": "fleet",
+            "error": (
+                f"identical tenants must share ONE group/probe dispatch, "
+                f"got groups={m['groups']} probes={m['warm_probe_dispatches']}"
+            ),
+        }
+    if m["warm_tick_dispatches"] > m["dispatch_budget"]:
+        return {
+            "tier": "fleet",
+            "error": (
+                f"{m['warm_tick_dispatches']} tick dispatches > budget "
+                f"{m['dispatch_budget']}"
+            ),
+        }
+    wall = m["tick_wall_p50_s"]
+    if inject_sleep_s:
+        time.sleep(inject_sleep_s)
+        wall += inject_sleep_s
+    return {
+        "tier": "fleet",
+        "platform": "cpu",
+        "wall_s": round(wall, 4),
+        "tick_wall_p95_s": m["tick_wall_p95_s"],
+        "num_tenants": m["num_tenants"],
+        "tenants_per_dispatch": m["tenants_per_dispatch"],
+        "warm_tick_dispatches": m["warm_tick_dispatches"],
+        "warm_compile_events": m["warm_compile_events"],
+        "published": m["published"],
+    }
+
+
 def _run_serving_tier(inject_sleep_s: float = 0.0) -> dict:
     """Serving-plane overload tier: p95 admitted latency + the shed contract.
 
@@ -607,6 +665,19 @@ def _controller_baseline(root: str) -> Optional[dict]:
     return {"wall_s": doc.get("reaction_p50_s")}
 
 
+def _fleet_baseline(root: str) -> Optional[dict]:
+    """Gate baseline for the fleet tier, derived from the committed bench
+    artifact (``benchmarks/BENCH_FLEET_cpu.json``) — same single-source
+    pattern as the controller/serving/traces/replication tiers."""
+    path = os.path.join(root, "benchmarks", "BENCH_FLEET_cpu.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return {"wall_s": doc.get("tick_wall_p50_s")}
+
+
 TIERS: Dict[str, GateTier] = {
     t.name: t
     for t in (
@@ -642,11 +713,15 @@ TIERS: Dict[str, GateTier] = {
                  "p95 + watch contract vs BENCH_REPLICATION_cpu.json",
                  build=None, bench_comparable=False,
                  runner=_run_replication_tier),
+        GateTier("fleet", "multi-tenant batched dispatch: 1 probe / 32 "
+                 "tenants + 0-compile warm tick vs BENCH_FLEET_cpu.json",
+                 build=None, bench_comparable=False,
+                 runner=_run_fleet_tier),
     )
 }
 DEFAULT_TIERS = (
     "config1", "config2_small", "mesh8", "exporter", "controller", "serving",
-    "sharded", "traces", "replication",
+    "sharded", "traces", "replication", "fleet",
 )
 
 
@@ -1019,6 +1094,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"dispatches={m.get('warm_dispatches')} "
                 f"warm_compiles={m.get('warm_compile_events')}"
             )
+        elif "tenants_per_dispatch" in m:   # fleet tier: batched multi-tenant
+            status = (
+                f"tick_p50={m['wall_s']}s "
+                f"tenants/dispatch={m.get('tenants_per_dispatch')} "
+                f"tick_dispatches={m.get('warm_tick_dispatches')} "
+                f"warm_compiles={m.get('warm_compile_events')} "
+                f"published={m.get('published')}"
+            )
         elif "deliveries" in m:   # replication tier: fan-out propagation p95
             status = (
                 f"p95_propagation={m['wall_s']}s "
@@ -1094,6 +1177,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             # and the replication tier against BENCH_REPLICATION_cpu.json
             # (scripts/bench_serving.py --replication)
             base = _replication_baseline(root)
+        if base is None and m["tier"] == "fleet":
+            # and the fleet tier against BENCH_FLEET_cpu.json
+            # (scripts/bench_fleet.py)
+            base = _fleet_baseline(root)
         if base is None:
             failures.append(
                 f"{m['tier']}: no committed gate baseline for this tier "

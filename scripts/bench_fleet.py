@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Benchmark the fleet controller's batched multi-tenant dispatch.
+
+The headline claim of the fleet plane (ROADMAP item 1): **N small tenants
+cost ~one compiled dispatch per goal step, not N** — every tenant's drift
+probe rides ONE vmapped ``_violations`` dispatch per fleet tick, and the
+triggered tenants share one grouped batched incremental optimize.  The
+measurement harness lives in ``cruise_control_tpu/fleet/bench.py`` (shared
+with the ``fleet`` tier of ``obs/gate.py`` and the acceptance tests, so the
+number the gate enforces is measured by the code that committed it): 32
+identical synthetic tenant clusters on one fleet, every tenant pumped into a
+disk-capacity violation per shift, then the warm fleet-tick dispatch/compile
+census read from the ``fleet_tick`` flight record.
+
+Regression gate (same pattern as ``scripts/bench_controller.py``): the
+measured warm fleet-tick p50 is compared against the committed
+``benchmarks/BENCH_FLEET_cpu.json``; a >25 % regression (after an absolute
+noise floor, × ``CC_TPU_GATE_WALL_SLACK`` on shared runners) exits 1.  ANY
+XLA compile event attributed to a measured tick also exits 1.  Batching
+contract violations — more than one goal-order group for identical tenants,
+more than one probe dispatch, or tick dispatches above the ``#goals + 4``
+budget — are infrastructure errors (exit 2): they are properties of the tick
+layout, not the machine.
+
+    python scripts/bench_fleet.py                     # run + gate
+    python scripts/bench_fleet.py --update-baseline   # regenerate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SCHEMA = 1
+BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "BENCH_FLEET_cpu.json",
+)
+MAX_WALL_RATIO = 1.25
+WALL_FLOOR_S = 0.05   # warm fleet ticks are ~tens of ms — sub-noise floor
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--repeats", type=int, default=1,
+                    help="bench runs; best tick p50 is gated (noise)")
+    ap.add_argument("--num-tenants", type=int, default=None,
+                    help="override the tenant count (default 32)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from cruise_control_tpu.fleet import bench
+
+    kwargs = {}
+    if args.num_tenants is not None:
+        kwargs["num_tenants"] = args.num_tenants
+    results = []
+    for _ in range(max(args.repeats, 1)):
+        results.append(bench.run_bench(**kwargs))
+    best = min(results, key=lambda r: r["tick_wall_p50_s"])
+    doc = {"schema": SCHEMA, **best}
+    print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+
+    # self-checks are infrastructure errors, not regressions: the batching
+    # layout (one group, one probe, dispatches <= #goals + 4) is a property
+    # of the fleet tick's construction, not the machine it ran on
+    want = doc["num_tenants"] * doc["shifts"]
+    if doc["published"] < want:
+        print(
+            f"fleet bench self-check failed: {doc['published']} published "
+            f"sets < {want} ({doc['num_tenants']} tenants x "
+            f"{doc['shifts']} shifts)",
+            file=sys.stderr,
+        )
+        return 2
+    if doc["groups"] != 1 or doc["warm_probe_dispatches"] != 1:
+        print(
+            f"fleet bench self-check failed: identical tenants must share "
+            f"ONE group/probe dispatch, got groups={doc['groups']} "
+            f"probes={doc['warm_probe_dispatches']}",
+            file=sys.stderr,
+        )
+        return 2
+    if doc["warm_tick_dispatches"] > doc["dispatch_budget"]:
+        print(
+            f"fleet bench self-check failed: {doc['warm_tick_dispatches']} "
+            f"dispatches > budget {doc['dispatch_budget']}",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.update_baseline:
+        with open(BASELINE, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"baseline written: {BASELINE}", file=sys.stderr)
+        return 0
+
+    if not os.path.exists(BASELINE):
+        print(f"missing baseline {BASELINE}; run --update-baseline", file=sys.stderr)
+        return 2
+    with open(BASELINE) as f:
+        base = json.load(f)
+    if (base.get("num_tenants") != doc["num_tenants"]
+            or base.get("shifts") != doc["shifts"]
+            or base.get("partitions") != doc["partitions"]):
+        print("workload mismatch vs baseline — regenerate it", file=sys.stderr)
+        return 2
+
+    failures = []
+    # absolute: ANY compile during a measured tick means a shape/static
+    # drifted between identical ticks — a fleet tick at compile speed
+    if doc["warm_compile_events"]:
+        failures.append(
+            f"{doc['warm_compile_events']} XLA compile event(s) during "
+            "measured warm fleet ticks (warm tick => zero compiles)"
+        )
+    slack = float(os.environ.get("CC_TPU_GATE_WALL_SLACK", "1.0"))
+    budget = base["tick_wall_p50_s"] * MAX_WALL_RATIO * slack + WALL_FLOOR_S
+    if doc["tick_wall_p50_s"] > budget:
+        failures.append(
+            f"fleet tick p50 {doc['tick_wall_p50_s']:.4f}s > budget "
+            f"{budget:.4f}s (baseline {base['tick_wall_p50_s']:.4f}s × "
+            f"{MAX_WALL_RATIO} × slack {slack} + {WALL_FLOOR_S}s floor)"
+        )
+    if failures:
+        print("FLEET REGRESSION:", file=sys.stderr)
+        for f_ in failures:
+            print(f"  - {f_}", file=sys.stderr)
+        return 1
+    print(
+        f"fleet gate OK: tick p50 {doc['tick_wall_p50_s']:.4f}s <= budget "
+        f"{budget:.4f}s, {doc['tenants_per_dispatch']} tenants/dispatch, "
+        "0 warm compiles",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

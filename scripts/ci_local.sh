@@ -64,6 +64,15 @@ python scripts/bench_serving.py --replication >/dev/null
 echo "== replication drill (writer chaos-killed mid-publish under open watches; multi-process, marked slow) =="
 python -m pytest tests/test_replication_drill.py -x -q
 
+echo "== fleet tier (multi-tenant controller: batched probe/optimize, grouping, legacy migration, drain arbitration) =="
+# the compile-heavy tick tests are slow-marked out of tier-1; run them
+# here BY NAME (sharded-step precedent) — only the 32-tenant acceptance
+# stays nightly (bench_fleet below measures the same contract)
+python -m pytest tests/test_fleet.py -x -q -k "not acceptance_32"
+
+echo "== fleet bench (32 tenants: 1-probe-dispatch/0-compile batching contract + tick-p50 vs committed baseline) =="
+python scripts/bench_fleet.py >/dev/null
+
 echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check; incl. the sharded tier vs BENCH_SHARDED_8dev_virtual.json) =="
 python scripts/bench_gate.py
 

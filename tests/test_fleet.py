@@ -1,0 +1,591 @@
+"""Fleet-controller tier: N tenant control loops, one batched control plane.
+
+What this module locks down (cruise_control_tpu/fleet/):
+
+* the batched dispatch contract — one vmapped drift probe per goal-order
+  group per fleet tick, the grouped incremental optimize inside the
+  ``#goals + 4`` budget, ZERO XLA compiles on warm ticks (asserted from the
+  ``fleet_tick`` flight record), and per-tenant proposals BIT-IDENTICAL to a
+  standalone single-tenant controller fed the same shifts;
+* grouping as correctness — tenants with differing goal orders never share a
+  stack (``stack_arrays`` refuses outright; the fleet groups first);
+* durability composition — a pre-fleet ``journal.dir/controller`` WAL is
+  adopted as the ``default`` tenant's namespace on first fleet startup, with
+  recovery/fencing/publish/restart losing no record and doubling no publish;
+* hierarchy — cross-tenant drain arbitration (budget, rotation, stagger),
+  tenant → admission-tier threading and per-tenant quota isolation;
+* the FLEET REST endpoint, client methods and ``cctpu fleet`` CLI.
+
+The slow 32-tenant acceptance test runs the exact harness that commits
+``benchmarks/BENCH_FLEET_cpu.json`` (fleet/bench.py, also the ``fleet`` gate
+tier) — the fast tests here use 2-3 tenants with ``max_rounds_per_tick=1``
+so the batched programs stay cheap to compile on the 1-core CI box.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import goals_base as G
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.api.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionRefused,
+)
+from cruise_control_tpu.controller import bench as cbench
+from cruise_control_tpu.controller.loop import (
+    ContinuousController,
+    ControllerConfig,
+)
+from cruise_control_tpu.controller.standing import (
+    ControllerJournal,
+    StandingProposalSet,
+)
+from cruise_control_tpu.core.journal import Journal
+from cruise_control_tpu.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.fleet import (
+    RESERVED_TENANT_NAMES,
+    FleetConfig,
+    FleetController,
+    adopt_legacy_namespace,
+)
+from cruise_control_tpu.fleet import bench as fbench
+from cruise_control_tpu.model import arrays as A
+from cruise_control_tpu.obs import RECORDER
+
+#: one tick shape for the whole module (mirrors tests/test_controller.py):
+#: max_rounds=1 keeps the batched per-goal programs cheap to compile
+FLEET_TICK_CFG = dict(
+    tick_interval_s=3_600.0,   # cadence off — drift (or force) triggers
+    drift_threshold=1.0,
+    max_rounds_per_tick=1,
+)
+
+WINDOW_MS = cbench.WINDOW_MS
+
+
+def _props(n: int = 2):
+    return [
+        ExecutionProposal(
+            tp=("T", i), partition_size=1.0, old_leader=0,
+            old_replicas=(0, 1), new_replicas=(0, 2),
+        )
+        for i in range(n)
+    ]
+
+
+def _standing(version: int, n: int = 2) -> StandingProposalSet:
+    return StandingProposalSet(
+        version=version, created_ms=1_000 + version, trigger="drift",
+        drift=2.0, proposals=_props(n),
+    )
+
+
+def _shift_cluster(backend, controller, victim: int, prev_hot):
+    """Reset the previous hot set, overload the partitions the controller's
+    TRACKED placement hosts on ``victim`` (same recipe as the bench)."""
+    for tp in prev_hot:
+        backend.set_partition_load(tp, list(cbench.BASE_LOAD))
+    hot = cbench.hot_partitions_on(controller, victim)
+    for tp in hot:
+        backend.set_partition_load(tp, [0.2, 50.0, 50.0, cbench.HOT_DISK])
+    return hot
+
+
+def _feed(monitors, now_ms: int) -> int:
+    """Two windows so the shifted samples land in a STABLE window on every
+    monitor (the still-filling window is excluded by the aggregator)."""
+    now_ms += WINDOW_MS
+    for m in monitors:
+        m.sample_once(now_ms=now_ms)
+    now_ms += WINDOW_MS
+    for m in monitors:
+        m.sample_once(now_ms=now_ms)
+    return now_ms
+
+
+def _proposal_keys(standing: StandingProposalSet):
+    return [
+        (p.tp, p.old_leader, tuple(p.old_replicas), tuple(p.new_replicas))
+        for p in standing.proposals
+    ]
+
+
+# -- the batched dispatch contract + bit-identity vs the single-tenant loop ---
+
+
+class TestFleetTick:
+    @pytest.mark.slow  # compile-heavy: 3 fleet tenants + 3 standalone twins;
+    # CI's fleet step runs it by name (ci_local.sh / ci.yml)
+    def test_warm_tick_census_and_bit_identity(self, tmp_path):
+        """One vmapped probe for all tenants, optimize within budget, zero
+        warm compiles — and every tenant's published proposals bit-identical
+        to a standalone single-tenant controller fed the same shifts."""
+        N = 3
+        fleet = FleetController(
+            config=FleetConfig(**FLEET_TICK_CFG),
+            journal_dir=str(tmp_path / "journal"),
+        )
+        tenants = []            # (backend, monitor)
+        for t in range(N):
+            backend, monitor, cc = cbench.build_cluster()
+            fleet.add_tenant(f"t{t}", cc)
+            tenants.append((backend, monitor))
+        # standalone twins: identical seeded clusters, identical tick shape
+        solos = []              # (backend, monitor, controller)
+        for t in range(N):
+            backend, monitor, controller, _ = cbench.build_harness(
+                config=ControllerConfig(**FLEET_TICK_CFG)
+            )
+            solos.append((backend, monitor, controller))
+        now = cbench.warm_window_clock()
+        for w in range(cbench.NUM_WINDOWS + 2):
+            ts = now + w * WINDOW_MS
+            for _, monitor in tenants:
+                monitor.sample_once(now_ms=ts)
+        now += (cbench.NUM_WINDOWS + 2) * WINDOW_MS
+
+        fleet.warm()
+        for _, _, sctl in solos:
+            sctl.warm_start()
+
+        fleet_hot = [[] for _ in range(N)]
+        solo_hot = [[] for _ in range(N)]
+
+        def shift_all(victim):
+            for t in range(N):
+                frt = fleet.tenant(f"t{t}")
+                fleet_hot[t] = _shift_cluster(
+                    tenants[t][0], frt.controller, victim, fleet_hot[t]
+                )
+                solo_hot[t] = _shift_cluster(
+                    solos[t][0], solos[t][2], victim, solo_hot[t]
+                )
+
+        # shift 1: settles initial placements + pays any first-tick host jits
+        shift_all(0)
+        now = _feed([m for _, m in tenants] + [m for _, m, _ in solos], now)
+        assert fleet.maybe_tick() is not None
+        for _, _, sctl in solos:
+            sctl.maybe_tick()
+
+        # shift 2: the measured warm tick
+        shift_all(1)
+        now = _feed([m for _, m in tenants] + [m for _, m, _ in solos], now)
+        attrs = fleet.maybe_tick()
+        assert attrs is not None
+
+        # census — identical tenants share ONE group and ONE vmapped probe
+        assert attrs["groups"] == 1
+        assert attrs["probe_dispatches"] == 1
+        assert attrs["tenants_per_dispatch"] == N
+        assert attrs["published"] == N
+        assert attrs["num_dispatches"] <= len(cbench.GOALS) + 4
+        # the 0-compile contract, from the fleet tick's flight record
+        trace = next(iter(RECORDER.recent(1, kind="fleet_tick")), None)
+        assert trace is not None
+        assert len(trace.compile_events) == 0
+        assert trace.attrs["num_dispatches"] == attrs["num_dispatches"]
+
+        # bit-identity: each tenant's standing set vs its standalone twin
+        for t in range(N):
+            fctl = fleet.tenant(f"t{t}").controller
+            sctl = solos[t][2]
+            assert sctl.maybe_tick() is not None
+            assert fctl.standing is not None and sctl.standing is not None
+            assert fctl.standing.version == sctl.standing.version
+            assert _proposal_keys(fctl.standing) == _proposal_keys(sctl.standing)
+            # ...and the tracked placements the next tick will probe
+            np.testing.assert_array_equal(
+                np.asarray(fctl._state_host.replica_broker),
+                np.asarray(sctl._state_host.replica_broker),
+            )
+
+        # per-tenant metric labels reached the registry
+        from cruise_control_tpu.obs.exporter import render_prometheus
+
+        page = render_prometheus()
+        assert 'family="Fleet",sensor="tenant.t0.' in page
+        assert 'family="Fleet",sensor="coordinator.ticks"' in page
+        fleet.stop()
+
+    @pytest.mark.slow  # compiles a second goal-order group end to end
+    def test_mixed_goal_orders_group_separately(self):
+        """Satellite regression: tenants under different goal orders must
+        never share a stack — the fleet groups them apart (two probe
+        dispatches, both still publish) and ``stack_arrays`` refuses a
+        mixed-order batch outright."""
+        alt_goals = (G.RACK_AWARE, G.REPLICA_CAPACITY, G.DISK_CAPACITY)
+        fleet = FleetController(config=FleetConfig(**FLEET_TICK_CFG))
+        b_full, m_full, cc_full = cbench.build_cluster()
+        fleet.add_tenant("full", cc_full)
+        b_alt, m_alt, _ = cbench.build_cluster()
+        cc_alt = CruiseControl(
+            b_alt, m_alt, Executor(b_alt),
+            goal_ids=alt_goals,
+            hard_ids=tuple(g for g in alt_goals if g in G.HARD_GOALS),
+        )
+        fleet.add_tenant("trim", cc_alt)
+        now = cbench.warm_window_clock()
+        for w in range(cbench.NUM_WINDOWS + 2):
+            ts = now + w * WINDOW_MS
+            m_full.sample_once(now_ms=ts)
+            m_alt.sample_once(now_ms=ts)
+        now += (cbench.NUM_WINDOWS + 2) * WINDOW_MS
+        fleet.warm()
+
+        k_full = fleet._group_key(fleet.tenant("full"))
+        k_trim = fleet._group_key(fleet.tenant("trim"))
+        assert k_full != k_trim
+
+        with pytest.raises(ValueError, match="differing goal orders"):
+            A.stack_arrays(
+                [
+                    fleet.tenant("full").controller._state_host,
+                    fleet.tenant("trim").controller._state_host,
+                ],
+                goal_orders=[cbench.GOALS, alt_goals],
+            )
+
+        _shift_cluster(b_full, fleet.tenant("full").controller, 0, [])
+        _shift_cluster(b_alt, fleet.tenant("trim").controller, 0, [])
+        now = _feed([m_full, m_alt], now)
+        attrs = fleet.maybe_tick()
+        assert attrs is not None
+        assert attrs["groups"] == 2
+        assert attrs["probe_dispatches"] == 2
+        assert attrs["published"] == 2
+        fleet.stop()
+
+    @pytest.mark.slow
+    def test_acceptance_32_tenants(self):
+        """The ISSUE's acceptance run — the exact harness behind
+        benchmarks/BENCH_FLEET_cpu.json and the ``fleet`` gate tier."""
+        m = fbench.run_bench()
+        assert m["published"] == m["num_tenants"] * m["shifts"]
+        assert m["groups"] == 1
+        assert m["warm_probe_dispatches"] == 1
+        assert m["warm_tick_dispatches"] <= m["dispatch_budget"]
+        assert m["warm_compile_events"] == 0
+        assert m["tenants_per_dispatch"] == m["num_tenants"]
+
+
+# -- tenant registry + coordinator plumbing (host-only) -----------------------
+
+
+class TestFleetRegistry:
+    def test_tenant_name_validation(self):
+        fleet = FleetController()
+        _, _, cc = cbench.build_cluster()
+        for bad in ("", "a/b", " padded ", *RESERVED_TENANT_NAMES):
+            with pytest.raises(ValueError):
+                fleet.add_tenant(bad, cc)
+        fleet.add_tenant("ok", cc)
+        with pytest.raises(ValueError, match="duplicate"):
+            _, _, cc2 = cbench.build_cluster()
+            fleet.add_tenant("ok", cc2)
+        assert fleet.tenant_names == ["ok"]
+
+    def test_pause_resume_fleet_and_single_tenant(self):
+        fleet = FleetController()
+        _, _, cc = cbench.build_cluster()
+        fleet.add_tenant("a", cc)
+        fleet.pause("ops")
+        assert fleet.paused and fleet.maybe_tick() is None
+        fleet.resume("ops done")
+        assert not fleet.paused
+        fleet.pause("noisy", tenant="a")
+        assert fleet.tenant("a").controller.paused
+        assert not fleet.paused            # fleet itself keeps running
+        fleet.resume(tenant="a")
+        assert not fleet.tenant("a").controller.paused
+
+    def test_drain_arbitration_budget_rotation_and_stagger(self):
+        """The coordinator grants at most ``max_concurrent_drains`` per tick
+        in tick-rotated order; a tenant inside its stagger window defers."""
+        clock = [1_000.0]
+        fleet = FleetController(
+            config=FleetConfig(
+                **FLEET_TICK_CFG, execute=True,
+                max_concurrent_drains=1, drain_stagger_s=300.0,
+            ),
+            clock=lambda: clock[0],
+        )
+        drained = []
+        runtimes = []
+        for name in ("a", "b"):
+            _, _, cc = cbench.build_cluster()
+            rt = fleet.add_tenant(name, cc)
+            rt.controller._drain_standing = (
+                lambda fh, _n=name: drained.append(_n) or True
+            )
+            runtimes.append(rt)
+        live = [(rt, None, None) for rt in runtimes]
+
+        for rt in runtimes:
+            rt.pending_drain = (object(), object())
+        drains, deferrals = fleet._arbitrate_drains(live)
+        assert (drains, deferrals) == (1, 1)
+        assert drained == ["a"]
+        assert all(rt.pending_drain is None for rt in runtimes)
+
+        # next tick: rotation starts at b; a is ALSO inside its stagger
+        fleet._tick_count = 1
+        for rt in runtimes:
+            rt.pending_drain = (object(), object())
+        drains, deferrals = fleet._arbitrate_drains(live)
+        assert (drains, deferrals) == (1, 1)
+        assert drained == ["a", "b"]
+
+        # stagger: nobody re-drains until the window passes
+        for rt in runtimes:
+            rt.pending_drain = (object(), object())
+        drains, deferrals = fleet._arbitrate_drains(live)
+        assert (drains, deferrals) == (0, 2)
+        clock[0] += 301.0
+        for rt in runtimes:
+            rt.pending_drain = (object(), object())
+        drains, _ = fleet._arbitrate_drains(live)
+        assert drains == 1
+        assert drained == ["a", "b", "b"]   # rotation still starts at b
+
+        # execute off: pending sets are cleared without any drain
+        fleet.cfg.execute = False
+        for rt in runtimes:
+            rt.pending_drain = (object(), object())
+        assert fleet._arbitrate_drains(live) == (0, 0)
+        assert all(rt.pending_drain is None for rt in runtimes)
+        assert drained == ["a", "b", "b"]   # no drain ran with execute off
+
+
+# -- satellite: legacy journal.dir/controller adoption ------------------------
+
+
+class TestLegacyMigration:
+    def _write_legacy(self, jdir: str) -> None:
+        legacy = ControllerJournal(Journal(os.path.join(jdir, "controller")))
+        legacy.fence(1)
+        legacy.published(_standing(3))
+        legacy.published(_standing(4, n=3))
+        legacy.close()
+
+    def test_adopt_moves_namespace_once(self, tmp_path):
+        jdir = str(tmp_path / "journal")
+        self._write_legacy(jdir)
+        assert adopt_legacy_namespace(jdir) is True
+        assert not os.path.exists(os.path.join(jdir, "controller"))
+        assert os.path.isdir(os.path.join(jdir, "default"))
+        # idempotent: nothing left to adopt
+        assert adopt_legacy_namespace(jdir) is False
+        # a fresh dir with nothing to adopt is a no-op too
+        assert adopt_legacy_namespace(str(tmp_path / "empty")) is False
+
+    def test_recover_fence_publish_restart_no_loss_no_double(self, tmp_path):
+        """The satellite's migration drill: old single-tenant layout →
+        fleet startup adopts it → recovery resumes the exact standing set
+        under a bumped fence → a new publish supersedes → restart replays
+        exactly one live set (no record loss, no double-publish)."""
+        jdir = str(tmp_path / "journal")
+        self._write_legacy(jdir)
+
+        fleet = FleetController(journal_dir=jdir)
+        _, _, cc = cbench.build_cluster()
+        rt = fleet.add_tenant("default", cc)
+        assert not os.path.exists(os.path.join(jdir, "controller"))
+        replayed = fleet.recover()
+        # epoch record + published v3 + published v4, all preserved
+        assert replayed == 3
+        ctl = rt.controller
+        assert ctl.standing is not None and ctl.standing.version == 4
+        assert len(ctl.standing.proposals) == 3
+        assert _proposal_keys(ctl.standing) == _proposal_keys(_standing(4, n=3))
+        # restart-and-adopt fences epoch+1: the legacy writer is deposed
+        assert ctl.journal.epoch == 2
+
+        # publish under the adopted namespace (what tick_commit appends)
+        ctl.journal.published(_standing(5, n=1))
+        ctl.journal.invalidated(4, "superseded by v5")
+        fleet.stop()
+
+        # restart: same records, a newer fence, exactly ONE live set
+        fleet2 = FleetController(journal_dir=jdir)
+        _, _, cc2 = cbench.build_cluster()
+        rt2 = fleet2.add_tenant("default", cc2)
+        assert fleet2.recover() > 0
+        ctl2 = rt2.controller
+        assert ctl2.standing is not None and ctl2.standing.version == 5
+        assert len(ctl2.standing.proposals) == 1
+        assert ctl2.journal.epoch == 3
+        fleet2.stop()
+
+        # the compacted WAL holds the live set once — no doubled publish
+        records = Journal(os.path.join(jdir, "default")).replay()
+        published = [r for r in records if r.get("type") == "published"]
+        assert [r["version"] for r in published] == [5]
+
+
+# -- satellite: tenant → admission tier + per-tenant quota isolation ----------
+
+
+class TestTenantAdmission:
+    def test_quota_shed_isolates_tenants_and_counts_exactly(self):
+        adm = AdmissionController(
+            AdmissionConfig(max_concurrent=10, max_tasks_per_principal=1)
+        )
+        fleet = FleetController(admission=adm)
+        _, _, cc_a = cbench.build_cluster()
+        _, _, cc_b = cbench.build_cluster()
+        fleet.add_tenant("tenantA", cc_a, tier=3)
+        fleet.add_tenant("tenantB", cc_b, tier=0)
+        # tenant → principal tier threading (set_tier_override)
+        assert adm.tier_of(None, True, principal="tenantA") == 3
+        assert adm.tier_of(None, True, principal="tenantB") == 0
+        assert fleet.tenant("tenantA").tier == 3
+
+        # tenantA saturates its quota; its SECOND acquire sheds instantly
+        # (the server maps AdmissionRefused → 429 + Retry-After)
+        ticket_a = adm.acquire("tenantA", "REBALANCE")
+        with pytest.raises(AdmissionRefused) as exc:
+            adm.acquire("tenantA", "REBALANCE")
+        assert exc.value.reason == "principal-quota"
+        assert exc.value.retry_after_s > 0
+
+        # ...while tenantB's REBALANCE admits in the same tick window
+        ticket_b = adm.acquire("tenantB", "REBALANCE")
+        snap = adm.snapshot()
+        assert snap["activeByPrincipal"] == {"tenantA": 1, "tenantB": 1}
+        assert snap["admitted"] == 2 and snap["shed"] == 1
+        assert snap["shedByReason"] == {"principal-quota": 1}
+        # counters account EXACTLY per tenant
+        assert adm.shed_by_principal == {"tenantA": 1}
+        ticket_a.release()
+        ticket_b.release()
+        snap = adm.snapshot()
+        assert snap["active"] == 0 and snap["activeByPrincipal"] == {}
+
+
+# -- the FLEET endpoint, client methods, CLI ----------------------------------
+
+
+GOAL_NAMES_CSV = ",".join(G.GOAL_NAMES[g] for g in cbench.GOALS)
+
+
+class TestFleetEndpoint:
+    @pytest.fixture()
+    def served(self, tmp_path):
+        from cruise_control_tpu.app import CruiseControlTpuApp
+        from cruise_control_tpu.backend import FakeClusterBackend
+        from cruise_control_tpu.client import CruiseControlClient
+        from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+
+        backend = FakeClusterBackend()
+        for b in range(cbench.BROKERS):
+            backend.add_broker(b, rack=str(b % cbench.RACKS))
+        for p in range(cbench.PARTITIONS):
+            backend.create_partition(
+                ("T", p), [p % cbench.BROKERS, (p + 1) % cbench.BROKERS],
+                load=list(cbench.BASE_LOAD),
+            )
+        props = {
+            "partition.metrics.window.ms": WINDOW_MS,
+            "num.partition.metrics.windows": cbench.NUM_WINDOWS,
+            "metric.sampling.interval.ms": 3_600_000,
+            "anomaly.detection.interval.ms": 3_600_000,
+            "anomaly.detection.initial.pass": False,
+            "broker.capacity.config.resolver.class":
+                "cruise_control_tpu.monitor.capacity.StaticCapacityResolver",
+            "sample.store.class":
+                "cruise_control_tpu.monitor.samplestore.NoopSampleStore",
+            "webserver.http.port": 0,
+            "min.valid.partition.ratio": 0.5,
+            "default.goals": GOAL_NAMES_CSV,
+            "fleet.enable": True,
+            "fleet.tick.interval.ms": 3_600_000,
+            "fleet.max.rounds.per.tick": 1,
+            "fleet.tenants": "beta",
+            "fleet.tenant.tiers": "default:2,beta:0",
+            # keep every tenant un-warmable: the endpoint tests exercise the
+            # REST surface, not device work (no windows → warm_start defers)
+            "demo.bootstrap.on.start": False,
+            "journal.dir": str(tmp_path / "journal"),
+        }
+        app = CruiseControlTpuApp(props, backend=backend)
+        app.monitor.capacity_resolver = StaticCapacityResolver(cbench.CAPACITY)
+        app.start(serve_http=True)
+        client = CruiseControlClient(
+            f"http://127.0.0.1:{app.port}", poll_timeout_s=600.0
+        )
+        yield app, client
+        app.stop()
+
+    def test_status_pause_resume_state_and_schema(self, served):
+        from cruise_control_tpu.api.schemas import validate_endpoint
+        from cruise_control_tpu.client import ClientError
+
+        app, client = served
+        assert app.controller is None      # fleet mode replaces the solo loop
+        assert client.controller_status()["enabled"] is False
+
+        body = client.fleet_status()
+        validate_endpoint("FLEET", body)
+        assert body["enabled"] is True
+        assert body["tenantCount"] == 2
+        assert set(body["tenants"]) == {"default", "beta"}
+        assert body["tenants"]["default"]["tier"] == 2
+        assert body["tenants"]["beta"]["tier"] == 0
+        assert body["config"]["maxRoundsPerTick"] == 1
+
+        # ?tenant= narrows to one tenant's block; unknown tenants 404
+        body = client.fleet_status(tenant="beta")
+        validate_endpoint("FLEET", body)
+        assert body["tenant"] == "beta"
+        with pytest.raises(ClientError) as exc:
+            client.fleet_status(tenant="nope")
+        assert exc.value.status == 404
+
+        # fleet-wide pause/resume over POST
+        body = client.fleet_pause(reason="ops")
+        validate_endpoint("FLEET", body)
+        assert body["paused"] is True and app.fleet.paused
+        assert client.fleet_resume()["paused"] is False
+
+        # per-tenant pause leaves the fleet (and the other tenant) running
+        body = client.fleet_pause(reason="noisy", tenant="beta")
+        assert body["paused"] is False
+        assert body["tenants"]["beta"]["paused"] is True
+        assert app.fleet.tenant("beta").controller.paused
+        client.fleet_resume(tenant="beta")
+        assert not app.fleet.tenant("beta").controller.paused
+
+        with pytest.raises(ClientError) as exc:
+            client._post("fleet", action="bogus")
+        assert exc.value.status == 400
+
+        # STATE carries the Fleet block; /metrics carries the fleet sensors
+        state = client.state()
+        assert state["Fleet"]["state"] == "running"
+        assert state["Fleet"]["tenantCount"] == 2
+        validate_endpoint("STATE", state)
+
+    def test_cli_fleet_subcommand(self, served, capsys):
+        from cruise_control_tpu.client import cli
+
+        app, client = served
+        url = f"http://127.0.0.1:{app.port}"
+        assert cli.main(["-a", url, "fleet", "status"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["enabled"] is True and out["tenantCount"] == 2
+        assert cli.main(["-a", url, "fleet", "pause", "--tenant", "beta",
+                         "--reason", "cli drill"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["tenants"]["beta"]["paused"] is True
+        assert cli.main(["-a", url, "fleet", "resume", "--tenant", "beta"]) == 0
+        capsys.readouterr()
+        assert not app.fleet.tenant("beta").controller.paused
