@@ -86,7 +86,7 @@ ANONYMOUS_PRINCIPAL = "(anonymous)"
 CHEAP_ENDPOINTS = {
     "HEALTHZ", "METRICS", "STATE", "TRACES", "USER_TASKS", "PERMISSIONS",
     "REVIEW_BOARD", "CONTROLLER", "FLEET", "ADMIN", "REVIEW",
-    "STOP_PROPOSAL_EXECUTION", "WATCH",
+    "STOP_PROPOSAL_EXECUTION", "WATCH", "SLO",
 }
 
 #: endpoint class ranks for queue priority (lower = drains first): cluster

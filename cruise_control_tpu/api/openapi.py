@@ -214,6 +214,23 @@ _ENDPOINT_PARAMS = {
                          "in one batched dispatch"),
          "methods": ["post"]},
     ],
+    "METRICS": [
+        {"name": "window", "in": "query", "required": False,
+         "schema": {"type": "integer"},
+         "description": ("additionally render the self-monitoring plane's "
+                         "last N stable windowed means per series "
+                         "(cruise_control_tpu_selfmon_window_value, "
+                         "labels series + window_id); requires "
+                         "selfmon.enable"),
+         "methods": ["get"]},
+    ],
+    "SLO": [
+        {"name": "slo", "in": "query", "required": False,
+         "schema": {"type": "string"},
+         "description": ("narrow to one declared SLO: answers that spec's "
+                         "block plus only its alerts"),
+         "methods": ["get"]},
+    ],
 }
 
 

@@ -159,6 +159,39 @@ _FLEET_STATUS = {
     "?tenant": str,
 }
 
+_SLO_STATUS = {
+    "enabled": bool,
+    #: fields below only when the self-monitoring plane is configured
+    #: (selfmon.enable)
+    "?specs": [dict],                # SloSpec.to_dict per declared objective
+    "?pairs": [dict],                # WindowPair.to_dict (fast/slow)
+    #: one block per (slo, pair) as of the last evaluation pass
+    "?alerts": [
+        {
+            "slo": str,
+            "pair": str,
+            "firing": bool,
+            "burn_long": (float, None),
+            "burn_short": (float, None),
+            "threshold": float,
+            "since_ms": (int, None),
+        }
+    ],
+    "?firing": int,
+    "?evaluations": int,
+    "?lastEvalMs": (int, None),
+    #: sampler accounting (obs/selfmon.py SelfMonitor.status())
+    "?selfmon": dict,
+    #: present when the answer was narrowed with ?slo=<name>
+    "?slo": str,
+    "?name": str,
+    "?series": str,
+    "?objective": float,
+    "?comparison": str,
+    "?budget": float,
+    "?description": str,
+}
+
 _READINESS = {
     "state": str,
     "ready": bool,
@@ -221,10 +254,14 @@ RESPONSE_SCHEMAS: Dict[str, Any] = {
         "?Breaker": _BREAKER,
         "?Controller": dict,
         "?Fleet": dict,
+        #: self-monitoring plane (selfmon.enable): sampler status + SLO
+        #: firing summary
+        "?SelfMonitor": dict,
     },
     "HEALTHZ": {"status": str, **_READINESS},
     "CONTROLLER": _CONTROLLER_STATUS,
     "FLEET": _FLEET_STATUS,
+    "SLO": _SLO_STATUS,
     "LOAD": {"brokers": [_BROKER_LOAD], "?hosts": [dict]},
     "PARTITION_LOAD": {"records": [dict], "?resource": str},
     "PROPOSALS": {

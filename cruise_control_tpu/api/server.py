@@ -65,7 +65,7 @@ API_PREFIX = "/kafkacruisecontrol/"
 GET_ENDPOINTS = {
     "STATE", "LOAD", "PARTITION_LOAD", "PROPOSALS", "KAFKA_CLUSTER_STATE",
     "USER_TASKS", "REVIEW_BOARD", "PERMISSIONS", "BOOTSTRAP", "TRAIN",
-    "TRACES", "METRICS", "HEALTHZ", "CONTROLLER", "WATCH", "FLEET",
+    "TRACES", "METRICS", "HEALTHZ", "CONTROLLER", "WATCH", "FLEET", "SLO",
 }
 #: endpoints whose 200 body is plain text, not JSON (Prometheus exposition)
 TEXT_ENDPOINTS = {"METRICS"}
@@ -317,6 +317,8 @@ class CruiseControlApp:
         max_active_user_tasks: int = 25,
         replication=None,
         replication_opts: Optional[dict] = None,
+        selfmon=None,
+        slo_engine=None,
     ) -> None:
         self.cc = cruise_control
         self.anomaly_manager = anomaly_manager
@@ -327,6 +329,11 @@ class CruiseControlApp:
         #: the multi-tenant fleet controller (fleet/controller.py), None
         #: unless fleet.enable — serves the FLEET endpoint + STATE block
         self.fleet = fleet
+        #: the self-monitoring sampler (obs/selfmon.py) and SLO burn-rate
+        #: engine (obs/slo.py), None unless selfmon.enable — serve the SLO
+        #: endpoint, the STATE SelfMonitor block, and METRICS ?window=
+        self.selfmon = selfmon
+        self.slo_engine = slo_engine
         self.security = security or NoSecurityProvider()
         self.two_step = two_step_verification
         # embedded/test construction defaults to always-ready; the app shell
@@ -428,6 +435,17 @@ class CruiseControlApp:
             body["Controller"] = self.controller.status()
         if self.fleet is not None:
             body["Fleet"] = self.fleet.status()
+        # self-monitoring plane: sampler cadence/spool accounting + the SLO
+        # engine's firing summary (full per-spec detail lives on GET /SLO)
+        if self.selfmon is not None:
+            block = self.selfmon.status()
+            if self.slo_engine is not None:
+                s = self.slo_engine.status()
+                block["slo"] = {
+                    "evaluations": s["evaluations"],
+                    "firing": s["firing"],
+                }
+            body["SelfMonitor"] = block
         return 200, body
 
     def get_healthz(self, params) -> Tuple[int, dict]:
@@ -584,11 +602,25 @@ class CruiseControlApp:
     def get_metrics(self, params) -> Tuple[int, str]:
         """Prometheus text exposition of the whole telemetry plane
         (``obs/exporter.py``): every sensor family, flight-recorder and gate
-        summaries, per-executable device cost, device memory.  Plain text —
-        the one endpoint a ``scrape_configs`` stanza points at."""
+        summaries, per-executable device cost, device memory, SLO burn
+        state.  Plain text — the one endpoint a ``scrape_configs`` stanza
+        points at.  ``window=N`` additionally renders the self-monitoring
+        plane's last N windowed means per series
+        (``cruise_control_tpu_selfmon_window_value``)."""
         from cruise_control_tpu.obs.exporter import render_prometheus
 
-        return 200, render_prometheus()
+        window = params.get("window", [None])[0]
+        selfmon_window = None
+        if window is not None:
+            try:
+                selfmon_window = int(window)
+                if selfmon_window < 0:
+                    raise ValueError
+            except ValueError:
+                selfmon_window = None
+        return 200, render_prometheus(
+            selfmon=self.selfmon, selfmon_window=selfmon_window
+        )
 
     def get_controller(self, params) -> Tuple[int, dict]:
         """Continuous-controller status: drift, staleness, the standing
@@ -617,6 +649,37 @@ class CruiseControlApp:
                     "tenants": sorted(body["tenants"]),
                 }
             return 200, {"enabled": True, "tenant": tenant, **block}
+        return 200, body
+
+    def get_slo(self, params) -> Tuple[int, dict]:
+        """SLO burn-rate engine status (``obs/slo.py``): every declared SLO
+        with its objective, latest value, and per-window-pair burn rates +
+        alert state; plus the sampler's own accounting.  ``slo=<name>``
+        narrows to one spec's block.  Answers ``{"enabled": false}`` when
+        the self-monitoring plane is not configured (``selfmon.enable``)."""
+        if self.slo_engine is None:
+            return 200, {"enabled": False}
+        body = {"enabled": True, **self.slo_engine.status()}
+        if self.selfmon is not None:
+            body["selfmon"] = self.selfmon.status()
+        name = params.get("slo", [None])[0]
+        if name is not None:
+            block = next(
+                (s for s in body["specs"] if s.get("name") == name), None
+            )
+            if block is None:
+                return 404, {
+                    "error": f"unknown slo {name!r}",
+                    "slos": sorted(s.get("name") for s in body["specs"]),
+                }
+            return 200, {
+                "enabled": True,
+                "slo": name,
+                **block,
+                "alerts": [
+                    a for a in body["alerts"] if a.get("slo") == name
+                ],
+            }
         return 200, body
 
     def get_watch(self, params) -> Tuple[int, dict]:

@@ -37,6 +37,7 @@ from cruise_control_tpu.detector.detectors import (
     DiskFailureDetector,
     ExecutionFailureDetector,
     GoalViolationDetector,
+    SelfMetricAnomalyFinder,
     SlowBrokerFinder,
     TopicReplicationFactorAnomalyFinder,
 )
@@ -371,6 +372,41 @@ class CruiseControlTpuApp:
                 self._replication = ReplicationState(writer=True)
                 self.controller.journal.listener = self._replication.apply
 
+        # self-monitoring plane (selfmon.enable): a fixed-cadence sampler
+        # turns the sensor registry (plus flight-recorder summary and
+        # profiler census) into windowed time-series, and the SLO burn-rate
+        # engine watches those series.  The spool is writer-only for the
+        # same reason the WALs are: two processes appending one file.
+        self.selfmon = None
+        self.slo_engine = None
+        self._selfmon_finder = None
+        if cfg.get("selfmon.enable"):
+            from cruise_control_tpu.obs.selfmon import SelfMonitor
+            from cruise_control_tpu.obs.slo import (
+                SloEngine,
+                build_pairs,
+                set_global_engine,
+                shipped_specs,
+            )
+
+            self.selfmon = SelfMonitor(
+                interval_s=cfg.get("selfmon.sample.interval.ms") / 1000.0,
+                num_windows=cfg.get("selfmon.num.windows"),
+                window_ms=cfg.get("selfmon.window.ms"),
+                spool_dir=(
+                    os.path.join(jdir, "selfmon")
+                    if jdir and self.replication_role == "writer"
+                    else None
+                ),
+                spool_max_bytes=cfg.get("selfmon.spool.max.bytes"),
+            )
+            self.slo_engine = SloEngine(
+                shipped_specs(cfg.get), self.selfmon, pairs=build_pairs(cfg.get)
+            )
+            # the module hook lets a bare render_prometheus() (the API
+            # server's existing call) pick up SLO families with no plumbing
+            set_global_engine(self.slo_engine)
+
         interval = cfg.get("anomaly.detection.interval.ms") / 1000.0
 
         def _iv(key):
@@ -415,6 +451,17 @@ class CruiseControlTpuApp:
                 _iv("execution.failure.detection.interval.ms"),
             ),
         ]
+        if self.slo_engine is not None:
+            # the fleet handle is attached after the fleet block below —
+            # the finder reads self.fleet per run(), so late binding is safe
+            self._selfmon_finder = SelfMetricAnomalyFinder(
+                self.slo_engine,
+                controller=self.controller,
+                cooldown_s=cfg.get("slo.selfheal.cooldown.ms") / 1000.0,
+            )
+            detectors.append(
+                (self._selfmon_finder, _iv("slo.detection.interval.ms"))
+            )
         notifier_cls = resolve_class(cfg.get("anomaly.notifier.class"))
         try:
             notifier: AnomalyNotifier = notifier_cls(
@@ -543,6 +590,11 @@ class CruiseControlTpuApp:
                     self._replication = ReplicationState(writer=True)
                     dflt.journal.listener = self._replication.apply
 
+        if self._selfmon_finder is not None and self.fleet is not None:
+            # fleet is built after the detector list: late-bind the handle
+            # so a burning SLO can pause fleet drains too
+            self._selfmon_finder.fleet = self.fleet
+
         self.app = CruiseControlApp(
             self.cruise_control,
             anomaly_manager=self.anomaly_manager,
@@ -560,6 +612,8 @@ class CruiseControlTpuApp:
             # plane: the task table cap and the admission slot count now both
             # come from the one knob
             max_active_user_tasks=cfg.get("max.active.user.tasks"),
+            selfmon=self.selfmon,
+            slo_engine=self.slo_engine,
             replication=self._replication,
             replication_opts={
                 "lag.bound.ms": cfg.get("replication.lag.bound.ms"),
@@ -722,6 +776,14 @@ class CruiseControlTpuApp:
 
         self._sampling_thread = threading.Thread(target=_sampling_loop, daemon=True)
         self._sampling_thread.start()
+        if self.selfmon is not None:
+            # one immediate sample so STATE/SLO answer from real data the
+            # moment the ladder opens, then the background cadence takes over
+            try:
+                self.selfmon.sample()
+            except Exception:
+                pass
+            self.selfmon.start()
         if self.controller is not None:
             # the loop thread wakes on window deltas (and on cadence); it
             # warm-starts itself lazily once the monitor has a stable window
@@ -733,8 +795,20 @@ class CruiseControlTpuApp:
             # the precompute refresher runs the solver — not follower work
             self.app.start_proposal_refresher()
 
+    def _stop_selfmon(self) -> None:
+        if self.selfmon is not None:
+            self.selfmon.stop()
+        if self.slo_engine is not None:
+            # drop the module hook so a later app (or test) never renders
+            # SLO families from a stopped engine
+            from cruise_control_tpu.obs.slo import GLOBAL_ENGINE, set_global_engine
+
+            if GLOBAL_ENGINE is self.slo_engine:
+                set_global_engine(None)
+
     def stop(self) -> None:
         self._stop.set()
+        self._stop_selfmon()
         if self._follower_tailer is not None:
             self._follower_tailer.stop()
         if self.controller is not None:
@@ -764,6 +838,7 @@ class CruiseControlTpuApp:
         their periodic optimizes dispatch (and, after a jit-cache clear,
         recompile) inside unrelated flight-record windows."""
         self._stop.set()
+        self._stop_selfmon()
         if self._follower_tailer is not None:
             self._follower_tailer.stop()
         if self.controller is not None:

@@ -68,6 +68,15 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--reason", default="cctpu",
                     help="operator note recorded with pause/resume")
 
+    sl = sub.add_parser(
+        "slo",
+        help="SLO burn-rate engine (GET /slo): every declared objective "
+             "with its latest value, per-window-pair burn rates, and alert "
+             "state, plus the self-monitoring sampler's accounting",
+    )
+    sl.add_argument("--slo", default=None,
+                    help="narrow to one declared SLO by name")
+
     wt = sub.add_parser(
         "watch",
         help="standing-proposal-set deltas via long-poll (GET /watch): "
@@ -213,6 +222,8 @@ def main(argv=None) -> int:
                 out = client.fleet_resume(reason=args.reason, tenant=args.tenant)
             else:
                 out = client.fleet_tick(tenant=args.tenant)
+        elif ep == "slo":
+            out = client.slo(name=args.slo)
         elif ep == "watch":
             if args.follow:
                 for delta in client.watch_iter(

@@ -241,6 +241,14 @@ class CruiseControlClient:
         ride the batched dispatch and trigger on their own drift)."""
         return self._post("fleet", action="tick", tenant=tenant)
 
+    def slo(self, name: Optional[str] = None) -> Any:
+        """GET /slo: the SLO burn-rate engine's status — every declared
+        objective with its latest value and per-window-pair burn rates +
+        alert state, plus the self-monitoring sampler's accounting.
+        ``name`` narrows to one spec's block.  ``{"enabled": false}`` when
+        ``selfmon.enable`` is off."""
+        return self._get("slo", slo=name)
+
     def watch(self, since: int = 0, timeout_ms: int = 0) -> Any:
         """GET /watch: long-poll standing-proposal-set deltas (published /
         superseded / drained / epoch, keyed by version) since the ``since``

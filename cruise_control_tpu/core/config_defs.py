@@ -467,6 +467,75 @@ def replication_config() -> ConfigDef:
     return d
 
 
+def selfmon_config() -> ConfigDef:
+    """Self-monitoring plane (obs/selfmon.py + obs/slo.py — TPU-specific, no
+    reference counterpart): the sensor-registry sampler, its windowed
+    aggregation/spool, and the SLO burn-rate engine + self-heal detector."""
+    d = ConfigDef()
+    d.define("selfmon.enable", Type.BOOLEAN, True, H,
+             "Sample the process's own sensor registry (plus flight-recorder "
+             "summary and profiler census) on a fixed cadence into windowed "
+             "time-series; feeds GET /METRICS?window=, the SLO endpoint, and "
+             "the SelfMetricAnomalyFinder.")
+    d.define("selfmon.sample.interval.ms", Type.LONG, 10_000, M,
+             "Sampler cadence.  Pure host-side work (no device dispatches); "
+             "the bench holds one sample under 1% of a warm controller tick.",
+             in_range(lo=1))
+    d.define("selfmon.num.windows", Type.INT, 60, M,
+             "Stable aggregation windows retained per series (the L0 "
+             "aggregator ring, current window excluded).", in_range(lo=1))
+    d.define("selfmon.window.ms", Type.LONG, 60_000, M,
+             "Width of one self-monitoring aggregation window.",
+             in_range(lo=1))
+    d.define("selfmon.spool.max.bytes", Type.LONG, 8 * 1024 * 1024, L,
+             "Size cap of the journal.dir/selfmon JSONL spool; on overflow "
+             "the active file rotates to selfmon.jsonl.1 (one generation "
+             "kept).", in_range(lo=1))
+    d.define("slo.burn.budget", Type.DOUBLE, 0.01, M,
+             "Error budget: the allowed bad-sample fraction per SLO (burn "
+             "rate 1.0 = spending exactly the budget).")
+    d.define("slo.fast.long.window.s", Type.DOUBLE, 3600.0, M,
+             "Fast (page) burn pair: long window seconds.")
+    d.define("slo.fast.short.window.s", Type.DOUBLE, 300.0, M,
+             "Fast (page) burn pair: short window seconds.")
+    d.define("slo.fast.burn.threshold", Type.DOUBLE, 14.4, M,
+             "Fast pair firing threshold (14.4 = 2% of a 30-day budget in "
+             "one hour, SRE Workbook table 5-2).")
+    d.define("slo.slow.long.window.s", Type.DOUBLE, 259_200.0, M,
+             "Slow (ticket) burn pair: long window seconds.")
+    d.define("slo.slow.short.window.s", Type.DOUBLE, 21_600.0, M,
+             "Slow (ticket) burn pair: short window seconds.")
+    d.define("slo.slow.burn.threshold", Type.DOUBLE, 1.0, M,
+             "Slow pair firing threshold.")
+    d.define("slo.reaction.p99.objective.s", Type.DOUBLE, 2.0, M,
+             "SLO: controller reaction-latency p99 must stay at or under "
+             "this many seconds.")
+    d.define("slo.shed.ratio.objective", Type.DOUBLE, 0.05, M,
+             "SLO: admission sheds / (sheds + admitted) per sampling period "
+             "must stay at or under this fraction.")
+    d.define("slo.degraded.ratio.objective", Type.DOUBLE, 0.05, M,
+             "SLO: deadline-expired (degraded=true) optimizes per optimize "
+             "must stay at or under this fraction.")
+    d.define("slo.dispatch.budget", Type.DOUBLE, 10.0, M,
+             "SLO: device dispatches of a warm controller tick must stay at "
+             "or under this budget (the controller contract is "
+             "len(goals)+3).")
+    d.define("slo.recompile.objective", Type.DOUBLE, 0.0, M,
+             "SLO: XLA compile events between samples in warm steady state "
+             "(0 = the warm-path zero-recompile contract).")
+    d.define("slo.replication.staleness.objective.ms", Type.DOUBLE, 5_000.0, M,
+             "SLO: follower staleness ms (live delta-propagation proxy) "
+             "must stay at or under this bound.")
+    d.define("slo.detection.interval.ms", Type.LONG, 30_000, M,
+             "SelfMetricAnomalyFinder cadence (each pass evaluates every "
+             "SLO's burn rates).", in_range(lo=1))
+    d.define("slo.selfheal.cooldown.ms", Type.LONG, 300_000, M,
+             "Minimum gap between SloBurnAnomaly emissions while the same "
+             "alert set keeps firing (a new slo/pair re-emits immediately).",
+             in_range(lo=0))
+    return d
+
+
 def cruise_control_config() -> ConfigDef:
     """The merged registry (KafkaCruiseControlConfig)."""
     d = ConfigDef()
@@ -480,6 +549,7 @@ def cruise_control_config() -> ConfigDef:
         anomaly_detector_config(),
         webserver_config(),
         replication_config(),
+        selfmon_config(),
     ):
         d.merge(group)
     return d

@@ -13,6 +13,7 @@ export surface is the REST API (and anything that scrapes it).
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from contextlib import contextmanager
@@ -25,6 +26,7 @@ class Timer:
     def __init__(self, window: int = 256) -> None:
         self._lock = threading.Lock()
         self._ring: List[float] = []
+        self._sorted: Optional[List[float]] = None
         self._window = window
         self.count = 0
         self.total_s = 0.0
@@ -38,8 +40,17 @@ class Timer:
             self.last_s = seconds
             self.max_s = max(self.max_s, seconds)
             self._ring.append(seconds)
+            evicted = None
             if len(self._ring) > self._window:
-                self._ring.pop(0)
+                evicted = self._ring.pop(0)
+            # once a snapshot has built the sorted view, keep it current
+            # incrementally (bisect is O(log n) + a C memmove) instead of
+            # invalidating: the self-monitoring sampler then never pays a
+            # full re-sort, even for timers updated between samples
+            if self._sorted is not None:
+                bisect.insort(self._sorted, seconds)
+                if evicted is not None:
+                    del self._sorted[bisect.bisect_left(self._sorted, evicted)]
 
     @contextmanager
     def time(self):
@@ -49,22 +60,44 @@ class Timer:
         finally:
             self.update(time.monotonic() - t0)
 
+    def _sorted_ring(self) -> List[float]:
+        # caller must hold self._lock; idle timers keep their sorted copy
+        # between self-monitoring samples, so repeated snapshots are O(1)
+        if self._sorted is None:
+            self._sorted = sorted(self._ring)
+        return self._sorted
+
     def _percentile(self, q: float) -> float:
         with self._lock:
-            if not self._ring:
+            data = self._sorted_ring()
+            if not data:
                 return 0.0
-            data = sorted(self._ring)
-        idx = min(int(q * len(data)), len(data) - 1)
-        return data[idx]
+            idx = min(int(q * len(data)), len(data) - 1)
+            return data[idx]
 
     def snapshot(self) -> Dict[str, float]:
+        # one sorted copy serves all three percentiles: the self-monitoring
+        # sampler snapshots every timer each period, and three separate
+        # _percentile() calls tripled the dominant sort cost
+        with self._lock:
+            data = self._sorted_ring()
+        n = len(data)
+
+        def pct(q: float) -> float:
+            return data[min(int(q * n), n - 1)] if n else 0.0
+
         return {
             "count": self.count,
             "mean_s": self.total_s / self.count if self.count else 0.0,
             "max_s": self.max_s,
             "last_s": self.last_s,
-            "p50_s": self._percentile(0.50),
-            "p95_s": self._percentile(0.95),
+            "p50_s": pct(0.50),
+            "p95_s": pct(0.95),
+            "p99_s": pct(0.99),
+            # samples currently in the percentile ring — a p95 over 3 samples
+            # and one over 256 are not the same confidence, and dashboards
+            # could not tell them apart before this key existed
+            "window_n": n,
         }
 
 
@@ -283,3 +316,15 @@ TRACE_PAIRS_COUNTER = "TraceEngine.pairs-evaluated"
 TRACE_ROLLOUT_TIMER = "TraceEngine.rollout-timer"
 TRACE_REPLAYS_COUNTER = "TraceEngine.replays"
 TRACE_REPLAY_STEPS_COUNTER = "TraceEngine.replay-steps"
+# self-monitoring plane (obs/selfmon.py, obs/slo.py): the sampler that turns
+# the registry itself into windowed time-series, and the SLO burn-rate engine
+# watching those series
+SELFMON_SAMPLES_COUNTER = "SelfMonitor.samples"
+SELFMON_SAMPLE_TIMER = "SelfMonitor.sample-timer"
+SELFMON_SERIES_GAUGE = "SelfMonitor.series"
+SELFMON_SPOOL_BYTES_GAUGE = "SelfMonitor.spool-bytes"
+SELFMON_SPOOL_ROTATIONS_COUNTER = "SelfMonitor.spool-rotations"
+SLO_EVALUATIONS_COUNTER = "SloEngine.evaluations"
+SLO_ALERTS_FIRING_GAUGE = "SloEngine.alerts-firing"
+SLO_SELF_HEALS_COUNTER = "SloEngine.self-heals"
+SLO_SELF_HEAL_RESUMES_COUNTER = "SloEngine.self-heal-resumes"

@@ -14,6 +14,7 @@ from cruise_control_tpu.detector.anomalies import (
     MaintenanceEventType,
     NotificationAction,
     NotificationResult,
+    SloBurnAnomaly,
     SlowBrokerAction,
     SlowBrokers,
     TopicReplicationFactorAnomaly,
@@ -25,6 +26,7 @@ from cruise_control_tpu.detector.detectors import (
     ExecutionFailureDetector,
     GoalViolationDetector,
     MaintenanceEventDetector,
+    SelfMetricAnomalyFinder,
     SlowBrokerFinder,
     TopicReplicationFactorAnomalyFinder,
 )
@@ -73,6 +75,8 @@ __all__ = [
     "ProvisionerResult",
     "ProvisionerState",
     "SelfHealingNotifier",
+    "SelfMetricAnomalyFinder",
+    "SloBurnAnomaly",
     "SlowBrokerAction",
     "SlowBrokerFinder",
     "SlowBrokers",

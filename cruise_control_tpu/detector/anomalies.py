@@ -30,6 +30,9 @@ class AnomalyType(enum.IntEnum):
     MAINTENANCE_EVENT = 5
     #: a proposal execution degraded (fatal backend error / dead / stuck tasks)
     EXECUTION_FAILURE = 6
+    #: the controller's own SLOs are burning error budget (obs/slo.py) — the
+    #: detector layer watching the watcher
+    SLO_BURN = 7
 
 
 class NotificationAction(enum.Enum):
@@ -242,6 +245,50 @@ class ExecutionFailure(Anomaly):
             f"ExecutionFailure{{id={self.execution_id}, dead={self.dead_tasks}, "
             f"failed={self.failed_tasks}, error={self.error!r}}}"
         )
+
+
+@dataclasses.dataclass
+class SloBurnAnomaly(Anomaly):
+    """One or more SLO burn-rate alerts firing against the process itself
+    (``obs/slo.py``).  Unlike every other anomaly, the fix targets the
+    *controller plane*, not the cluster: a bounded self-heal that flips the
+    continuous controller to paused — degraded answers keep being served
+    from the journaled standing set — and pauses fleet drain arbitration,
+    shrinking the blast radius while the operator (or recovery) catches up.
+    The emitting :class:`SelfMetricAnomalyFinder` auto-resumes both once
+    every alert clears, so the heal is a state, not a ratchet."""
+
+    #: SloAlert.to_dict() blocks of the alerts firing at detection time
+    alerts: List[dict] = dataclasses.field(default_factory=list)
+    #: handles the finder bound at construction (None = surface only)
+    controller: Optional[object] = dataclasses.field(default=None, repr=False)
+    fleet: Optional[object] = dataclasses.field(default=None, repr=False)
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.SLO_BURN
+
+    def _reason(self) -> str:
+        slos = sorted({a.get("slo", "?") for a in self.alerts})
+        return f"slo-burn: {', '.join(slos)}"
+
+    def fix_with(self, cc):
+        actions: List[str] = []
+        reason = self._reason()
+        if self.controller is not None and not getattr(
+            self.controller, "paused", False
+        ):
+            self.controller.pause(reason)
+            actions.append("controller-paused")
+        if self.fleet is not None and not getattr(self.fleet, "paused", False):
+            self.fleet.pause(reason)
+            actions.append("fleet-drains-paused")
+        return {"actions": actions, "reason": reason}
+
+    def description(self) -> str:
+        pairs = sorted(
+            {f"{a.get('slo', '?')}/{a.get('pair', '?')}" for a in self.alerts}
+        )
+        return f"SloBurnAnomaly{{{', '.join(pairs)}}}"
 
 
 @dataclasses.dataclass

@@ -401,3 +401,92 @@ class PartitionSizeAnomalyFinder(Detector):
                 PartitionSizeAnomaly(oversized=oversized, size_limit=self.size_limit)
             ]
         return []
+
+
+class SelfMetricAnomalyFinder(Detector):
+    """The detector layer watching the watcher: evaluates the SLO burn-rate
+    engine (``obs/slo.py``) each cycle and surfaces firing alerts as
+    :class:`SloBurnAnomaly` — notification, cooldown, and a bounded
+    self-heal ride the same :class:`AnomalyDetectorManager` pipeline as
+    every cluster anomaly.
+
+    Self-heal is symmetric and non-ratcheting: when this finder's anomaly
+    pauses the controller/fleet (``SloBurnAnomaly.fix_with``), the finder
+    remembers it owns the pause and resumes both as soon as every alert
+    clears; an operator pause (different reason string) is never touched.
+    ``cooldown_s`` rate-limits re-emission while the same burn keeps firing
+    so one sustained incident is one anomaly, not one per detection cycle."""
+
+    name = "SelfMetricAnomalyFinder"
+
+    #: pause-reason prefix marking a pause as ours to undo
+    REASON_PREFIX = "slo-burn"
+
+    def __init__(
+        self,
+        engine,
+        controller=None,
+        fleet=None,
+        cooldown_s: float = 300.0,
+        now: Optional[Callable[[], float]] = None,
+    ) -> None:
+        self.engine = engine
+        self.controller = controller
+        self.fleet = fleet
+        self.cooldown_s = cooldown_s
+        self._now = now or time.monotonic
+        #: frozenset of firing (slo, pair) keys at last emission + its time
+        self._last_emit_keys: frozenset = frozenset()
+        self._last_emit_t: Optional[float] = None
+        self.anomalies_emitted = 0
+        self.resumes = 0
+
+    def _maybe_resume(self) -> None:
+        from cruise_control_tpu.core.sensors import (
+            REGISTRY,
+            SLO_SELF_HEAL_RESUMES_COUNTER,
+        )
+
+        for target in (self.controller, self.fleet):
+            if target is None or not getattr(target, "paused", False):
+                continue
+            reason = getattr(target, "pause_reason", "") or ""
+            if reason.startswith(self.REASON_PREFIX):
+                target.resume("slo recovered")
+                self.resumes += 1
+                REGISTRY.counter(SLO_SELF_HEAL_RESUMES_COUNTER).inc()
+
+    def run(self) -> List[Anomaly]:
+        from cruise_control_tpu.core.sensors import (
+            REGISTRY,
+            SLO_SELF_HEALS_COUNTER,
+        )
+        from cruise_control_tpu.detector.anomalies import SloBurnAnomaly
+
+        self.engine.evaluate()
+        firing = self.engine.firing()
+        if not firing:
+            self._maybe_resume()
+            self._last_emit_keys = frozenset()
+            return []
+        keys = frozenset((a.slo, a.pair) for a in firing)
+        now = self._now()
+        in_cooldown = (
+            self._last_emit_t is not None
+            and now - self._last_emit_t < self.cooldown_s
+        )
+        # re-emit on any new (slo, pair) even mid-cooldown — a second
+        # objective starting to burn is new information, not the same page
+        if in_cooldown and keys <= self._last_emit_keys:
+            return []
+        self._last_emit_keys = keys
+        self._last_emit_t = now
+        self.anomalies_emitted += 1
+        REGISTRY.counter(SLO_SELF_HEALS_COUNTER).inc()
+        return [
+            SloBurnAnomaly(
+                alerts=[a.to_dict() for a in firing],
+                controller=self.controller,
+                fleet=self.fleet,
+            )
+        ]

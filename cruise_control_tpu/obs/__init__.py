@@ -23,6 +23,14 @@ arXiv:1705.01662) and measured-speedup discipline (*CvxCluster*):
   registry (HLO FLOPs/bytes, call counts, attributed compiles) + per-device
   memory gauges sampled at trace boundaries; pure host-side, zero added
   dispatches on warm paths.
+- :mod:`cruise_control_tpu.obs.selfmon` — the self-monitoring plane: a
+  fixed-cadence sampler turning the sensor registry (plus flight-recorder
+  summary and profiler census) into windowed time-series via the L0
+  aggregator, spooled under ``journal.dir/selfmon``.
+- :mod:`cruise_control_tpu.obs.slo` — declarative SLO specs over those
+  series with multi-window burn-rate alerting (fast 5m/1h page pair + slow
+  6h/3d ticket pair), feeding the ``SLO`` endpoint, first-class Prometheus
+  families, and the ``SelfMetricAnomalyFinder`` self-heal loop.
 """
 
 from cruise_control_tpu.obs.recorder import (  # noqa: F401
@@ -30,8 +38,20 @@ from cruise_control_tpu.obs.recorder import (  # noqa: F401
     FlightRecorder,
     Span,
     TraceRecord,
+    append_jsonl_capped,
     current_parent_id,
     parent_scope,
     read_jsonl,
 )
 from cruise_control_tpu.obs.profiler import PROFILER, profile_jit  # noqa: F401
+from cruise_control_tpu.obs.selfmon import SelfMonitor, read_spool  # noqa: F401
+from cruise_control_tpu.obs.slo import (  # noqa: F401
+    DEFAULT_PAIRS,
+    SloAlert,
+    SloEngine,
+    SloSpec,
+    WindowPair,
+    build_pairs,
+    set_global_engine,
+    shipped_specs,
+)

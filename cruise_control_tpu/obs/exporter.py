@@ -102,6 +102,7 @@ def _render_sensors(families: Dict[str, _Family], registry) -> None:
     snap = registry.snapshot()
     timer_s = families[f"{PREFIX}_timer_seconds"]
     timer_n = families[f"{PREFIX}_timer_count"]
+    timer_w = families[f"{PREFIX}_timer_window_samples"]
     gauge = families[f"{PREFIX}_gauge"]
     counter = families[f"{PREFIX}_counter_total"]
     meter_n = families[f"{PREFIX}_meter_total"]
@@ -111,8 +112,9 @@ def _render_sensors(families: Dict[str, _Family], registry) -> None:
         fam, leaf = _split_family(name)
         labels = {"family": fam, "sensor": leaf}
         timer_n.add(labels, stats["count"])
-        for stat in ("mean", "max", "last", "p50", "p95"):
-            timer_s.add({**labels, "stat": stat}, stats[f"{stat}_s"])
+        timer_w.add(labels, stats.get("window_n"))
+        for stat in ("mean", "max", "last", "p50", "p95", "p99"):
+            timer_s.add({**labels, "stat": stat}, stats.get(f"{stat}_s"))
     for name, value in snap.get("gauges", {}).items():
         fam, leaf = _split_family(name)
         gauge.add({"family": fam, "sensor": leaf}, value)
@@ -213,11 +215,48 @@ def _render_gate(families: Dict[str, _Family]) -> None:
                 fam.add({"tier": tier, "metric": metric}, m[metric])
 
 
+def _render_slo(families: Dict[str, _Family], engine) -> None:
+    """First-class SLO series (obs/slo.py): alert state must be scrapeable
+    without parsing the generic sensor families — a burning objective is THE
+    page signal, same rationale as the dedicated ``_ready`` gauge."""
+    value_f = families[f"{PREFIX}_slo_value"]
+    objective_f = families[f"{PREFIX}_slo_objective"]
+    burn_f = families[f"{PREFIX}_slo_burn_rate"]
+    firing_f = families[f"{PREFIX}_slo_alert_firing"]
+    for spec in engine.specs:
+        labels = {"slo": spec.name}
+        value_f.add(labels, engine.source.latest(spec.series))
+        objective_f.add(labels, spec.objective)
+    for alert in engine.status()["alerts"]:
+        labels = {"slo": alert["slo"], "pair": alert["pair"]}
+        burn_f.add({**labels, "window": "long"}, alert["burn_long"])
+        burn_f.add({**labels, "window": "short"}, alert["burn_short"])
+        firing_f.add(labels, 1.0 if alert["firing"] else 0.0)
+
+
+def _render_selfmon_windows(
+    families: Dict[str, _Family], selfmon, max_windows: int
+) -> None:
+    """The aggregated time-series view behind ``GET /METRICS?window=N``:
+    per-series window means over the last N stable aggregator windows."""
+    fam = families[f"{PREFIX}_selfmon_window_value"]
+    doc = selfmon.windows(max_windows=max_windows)
+    for series, values in sorted(doc["series"].items()):
+        for win_id, value in zip(doc["window_ids"][-len(values):], values):
+            fam.add({"series": series, "window_id": str(win_id)}, value)
+
+
 _FAMILY_DEFS = {
     f"{PREFIX}_timer_seconds": (
-        "gauge", "Sensor-registry timer statistics (stat: mean/max/last/p50/p95)"
+        "gauge",
+        "Sensor-registry timer statistics (stat: mean/max/last/p50/p95/p99)",
     ),
     f"{PREFIX}_timer_count": ("counter", "Sensor-registry timer update counts"),
+    f"{PREFIX}_timer_window_samples": (
+        "gauge",
+        "Samples in each timer's percentile ring (the confidence behind "
+        "p50/p95/p99)",
+    ),
     f"{PREFIX}_gauge": ("gauge", "Sensor-registry gauges (last written value)"),
     f"{PREFIX}_counter_total": ("counter", "Sensor-registry monotonic counters"),
     f"{PREFIX}_meter_total": ("counter", "Sensor-registry meter event totals"),
@@ -264,22 +303,54 @@ _FAMILY_DEFS = {
     f"{PREFIX}_recovery_records_replayed": (
         "gauge", "Journal records replayed by the last startup recovery pass"
     ),
+    f"{PREFIX}_slo_value": (
+        "gauge", "Latest sampled value of each SLO's self-monitoring series"
+    ),
+    f"{PREFIX}_slo_objective": ("gauge", "Configured objective of each SLO"),
+    f"{PREFIX}_slo_burn_rate": (
+        "gauge",
+        "Burn rate (bad-fraction / error budget) per SLO, window pair, and "
+        "window (long/short)",
+    ),
+    f"{PREFIX}_slo_alert_firing": (
+        "gauge",
+        "1 while the multi-window burn-rate alert fires for (slo, pair)",
+    ),
+    f"{PREFIX}_selfmon_window_value": (
+        "gauge",
+        "Self-monitoring series aggregated per stable window "
+        "(GET /METRICS?window=N)",
+    ),
 }
 
 
-def render_prometheus(registry=None, recorder=None, profiler=None) -> str:
-    """The full /METRICS page.  Defaults to the process-wide singletons."""
+def render_prometheus(
+    registry=None,
+    recorder=None,
+    profiler=None,
+    slo_engine=None,
+    selfmon=None,
+    selfmon_window: Optional[int] = None,
+) -> str:
+    """The full /METRICS page.  Defaults to the process-wide singletons
+    (including the app-registered global SLO engine); ``selfmon_window=N``
+    additionally renders the last N stable self-monitoring windows per
+    series (the ``?window=`` query surface)."""
     from cruise_control_tpu.core.sensors import (
         EXPORTER_RENDER_TIMER,
         METRICS_SCRAPES_COUNTER,
         REGISTRY,
     )
+    from cruise_control_tpu.obs import slo as _slo
     from cruise_control_tpu.obs.profiler import PROFILER
     from cruise_control_tpu.obs.recorder import RECORDER
 
     registry = registry if registry is not None else REGISTRY
     recorder = recorder if recorder is not None else RECORDER
     profiler = profiler if profiler is not None else PROFILER
+    slo_engine = slo_engine if slo_engine is not None else _slo.GLOBAL_ENGINE
+    if selfmon is None and slo_engine is not None:
+        selfmon = getattr(slo_engine, "source", None)
 
     t0 = time.monotonic()
     # self-monitoring: the in-progress scrape is counted BEFORE the registry
@@ -298,6 +369,10 @@ def render_prometheus(registry=None, recorder=None, profiler=None) -> str:
     _render_profiler(families, profiler)
     _render_readiness(families, registry)
     _render_gate(families)
+    if slo_engine is not None:
+        _render_slo(families, slo_engine)
+    if selfmon is not None and selfmon_window is not None and selfmon_window > 0:
+        _render_selfmon_windows(families, selfmon, selfmon_window)
     out: List[str] = []
     for fam in families.values():
         fam.render(out)

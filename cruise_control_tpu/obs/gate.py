@@ -612,6 +612,67 @@ def _run_sharded_tier(inject_sleep_s: float = 0.0) -> dict:
     }
 
 
+def _run_slo_tier(inject_sleep_s: float = 0.0) -> dict:
+    """Self-monitoring plane tier: sampler overhead + SLO burn alerting.
+
+    Runs the SAME harness that commits ``benchmarks/BENCH_SELFMON_cpu.json``
+    (``cruise_control_tpu/obs/selfmon_bench.py``): sampler ticks over a
+    real-app-scale registry, quiet SLO evaluation, an induced reaction-
+    latency burn (real sleeps measured by the timer), recovery.  Hard
+    contracts — any sampler device dispatch or compile event, any quiet-run
+    false positive, a fast-window alert later than 2 sampling periods into
+    the burn, a missing self-heal/auto-resume — are errors; the sampler
+    wall p50 is the gated metric (>25 % vs the committed artifact fails,
+    see ``_selfmon_baseline``)."""
+    _force_cpu_platform()
+    from cruise_control_tpu.obs import selfmon_bench as bench
+
+    m = bench.run_bench()
+    errors = []
+    if m["sampler_dispatches"] or m["sampler_compile_events"]:
+        errors.append(
+            f"sampler made {m['sampler_dispatches']} dispatch(es) / "
+            f"{m['sampler_compile_events']} compile event(s) (must be host-only)"
+        )
+    if m["quiet_false_positives"]:
+        errors.append(
+            f"{m['quiet_false_positives']} false-positive alert(s) on the "
+            "quiet run"
+        )
+    if (
+        m["burn_periods_to_alert"] is None
+        or m["burn_periods_to_alert"] > bench.MAX_PERIODS_TO_ALERT
+    ):
+        errors.append(
+            f"fast-window alert after {m['burn_periods_to_alert']} burn "
+            f"period(s) (bound {bench.MAX_PERIODS_TO_ALERT})"
+        )
+    if not m["paused_by_heal"] or not m["auto_resumed"]:
+        errors.append(
+            f"self-heal incomplete (paused_by_heal={m['paused_by_heal']}, "
+            f"auto_resumed={m['auto_resumed']})"
+        )
+    if errors:
+        return {"tier": "slo", "error": "; ".join(errors)}
+    wall = m["sample_p50_s"]
+    if inject_sleep_s:
+        time.sleep(inject_sleep_s)
+        wall += inject_sleep_s
+    return {
+        "tier": "slo",
+        "platform": "cpu",
+        "wall_s": round(wall, 6),
+        "overhead_ratio": m["overhead_ratio"],
+        "series_count": m["series_count"],
+        "sampler_dispatches": m["sampler_dispatches"],
+        "sampler_compile_events": m["sampler_compile_events"],
+        "quiet_false_positives": m["quiet_false_positives"],
+        "burn_periods_to_alert": m["burn_periods_to_alert"],
+        "anomalies_emitted": m["anomalies_emitted"],
+        "auto_resumed": m["auto_resumed"],
+    }
+
+
 def _serving_baseline(root: str) -> Optional[dict]:
     """Gate baseline for the serving tier, derived from the committed bench
     artifact (``benchmarks/BENCH_SERVING_cpu.json``) — same single-source
@@ -649,6 +710,19 @@ def _replication_baseline(root: str) -> Optional[dict]:
     except (OSError, json.JSONDecodeError):
         return None
     return {"wall_s": doc.get("p95_propagation_s")}
+
+
+def _selfmon_baseline(root: str) -> Optional[dict]:
+    """Gate baseline for the slo tier, derived from the committed bench
+    artifact (``benchmarks/BENCH_SELFMON_cpu.json``) — same single-source
+    pattern as the controller/serving/traces/replication/fleet tiers."""
+    path = os.path.join(root, "benchmarks", "BENCH_SELFMON_cpu.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return {"wall_s": doc.get("sample_p50_s")}
 
 
 def _controller_baseline(root: str) -> Optional[dict]:
@@ -717,11 +791,15 @@ TIERS: Dict[str, GateTier] = {
                  "tenants + 0-compile warm tick vs BENCH_FLEET_cpu.json",
                  build=None, bench_comparable=False,
                  runner=_run_fleet_tier),
+        GateTier("slo", "self-monitoring plane: sampler overhead + burn "
+                 "alerting vs BENCH_SELFMON_cpu.json",
+                 build=None, bench_comparable=False,
+                 runner=_run_slo_tier),
     )
 }
 DEFAULT_TIERS = (
     "config1", "config2_small", "mesh8", "exporter", "controller", "serving",
-    "sharded", "traces", "replication", "fleet",
+    "sharded", "traces", "replication", "fleet", "slo",
 )
 
 
@@ -1110,6 +1188,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"5xx={m.get('http_5xx')} "
                 f"regressions={m.get('version_regressions')}"
             )
+        elif "quiet_false_positives" in m:   # slo tier: self-monitoring plane
+            status = (
+                f"sample_p50={m['wall_s']}s "
+                f"overhead={m.get('overhead_ratio', 0) * 100:.2f}% "
+                f"alert_in={m.get('burn_periods_to_alert')} "
+                f"false_positives={m.get('quiet_false_positives')} "
+                f"resumed={m.get('auto_resumed')}"
+            )
         elif "goodput_rps" in m:   # serving tier: admitted p95 + shed contract
             status = (
                 f"p95_admitted={m['wall_s']}s admitted={m.get('admitted')} "
@@ -1181,6 +1267,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             # and the fleet tier against BENCH_FLEET_cpu.json
             # (scripts/bench_fleet.py)
             base = _fleet_baseline(root)
+        if base is None and m["tier"] == "slo":
+            # and the slo tier against BENCH_SELFMON_cpu.json
+            # (scripts/bench_selfmon.py)
+            base = _selfmon_baseline(root)
         if base is None:
             failures.append(
                 f"{m['tier']}: no committed gate baseline for this tier "

@@ -204,23 +204,65 @@ def compile_events_since(mark: int) -> List[dict]:
 # -- the recorder -------------------------------------------------------------------
 
 
+def append_jsonl_capped(
+    path: str, line: str, max_bytes: Optional[int]
+) -> int:
+    """Append ``line`` to a size-capped JSONL sink, rotating ``path`` →
+    ``path + ".1"`` when the append would push it past ``max_bytes``
+    (None/<=0 = unbounded).  Returns the number of rotations performed
+    (0 or 1).
+
+    Crash-safety: rotation is a single atomic ``os.replace`` — at every
+    instant the active history lives under exactly one of the two names
+    (``path`` before the replace, ``path + ".1"`` after it; the next append
+    recreates ``path``), so a crash mid-rotation never loses the active
+    file.  Raises OSError like a plain append would — callers that must not
+    fail (the flight recorder) keep their own guard."""
+    rotations = 0
+    if max_bytes and max_bytes > 0:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if size > 0 and size + len(line) + 1 > max_bytes:
+            os.replace(path, path + ".1")
+            rotations = 1
+    with open(path, "a") as f:
+        f.write(line + "\n")
+    return rotations
+
+
 class FlightRecorder:
     """Ring buffer + optional JSONL sink for :class:`TraceRecord`\\ s."""
 
     def __init__(
-        self, capacity: int = 256, jsonl_path: Optional[str] = None
+        self,
+        capacity: int = 256,
+        jsonl_path: Optional[str] = None,
+        jsonl_max_bytes: Optional[int] = None,
     ) -> None:
         self.capacity = capacity
         self.jsonl_path = jsonl_path
+        self.jsonl_max_bytes = jsonl_max_bytes
         self._lock = threading.Lock()
         self._ring: List[TraceRecord] = []
         self._ids = itertools.count(1)
         self._dropped = 0
+        self._rotations = 0
 
-    def configure(self, jsonl_path: Optional[str]) -> None:
-        """Point (or disable, with None) the append-only JSONL sink."""
+    def configure(
+        self,
+        jsonl_path: Optional[str],
+        jsonl_max_bytes: Optional[int] = None,
+    ) -> None:
+        """Point (or disable, with None) the append-only JSONL sink.
+        ``jsonl_max_bytes`` caps the active file; on overflow it rotates to
+        ``<path>.1`` (one generation kept, like the reference's bounded
+        operation logs)."""
         with self._lock:
             self.jsonl_path = jsonl_path
+            if jsonl_max_bytes is not None:
+                self.jsonl_max_bytes = jsonl_max_bytes
 
     def next_trace_id(self, kind: str) -> str:
         return f"{kind}-{next(self._ids)}-{os.getpid()}"
@@ -243,15 +285,18 @@ class FlightRecorder:
                 del self._ring[:trimmed]
                 self._dropped += trimmed
             path = self.jsonl_path
+            max_bytes = self.jsonl_max_bytes
             size = len(self._ring)
         if path:
             line = json.dumps(trace.to_dict(), default=str)
             try:
-                with open(path, "a") as f:
-                    f.write(line + "\n")
+                rotated = append_jsonl_capped(path, line, max_bytes)
             except OSError:
                 # a full/readonly disk must never take down the solver
-                pass
+                rotated = 0
+            if rotated:
+                with self._lock:
+                    self._rotations += rotated
         REGISTRY.counter(FLIGHT_TRACES_COUNTER).inc()
         REGISTRY.gauge(FLIGHT_RING_GAUGE).set(size)
         REGISTRY.timer(f"FlightRecorder.{trace.kind}-duration").update(
@@ -296,6 +341,8 @@ class FlightRecorder:
                 "dropped": self._dropped,
                 "by_kind": kinds,
                 "jsonl_path": self.jsonl_path,
+                "jsonl_max_bytes": self.jsonl_max_bytes,
+                "jsonl_rotations": self._rotations,
             }
 
 
@@ -408,6 +455,17 @@ def finish_trace(
         return None
 
 
+def _env_max_bytes() -> Optional[int]:
+    try:
+        return int(os.environ.get("CC_TPU_FLIGHT_JSONL_MAX_BYTES", "0")) or None
+    except ValueError:
+        return None
+
+
 #: process-wide default recorder (the flight-data singleton every subsystem
-#: emits into); CC_TPU_FLIGHT_JSONL points the persistent sink
-RECORDER = FlightRecorder(jsonl_path=os.environ.get("CC_TPU_FLIGHT_JSONL"))
+#: emits into); CC_TPU_FLIGHT_JSONL points the persistent sink and
+#: CC_TPU_FLIGHT_JSONL_MAX_BYTES caps it (rotating to <path>.1 on overflow)
+RECORDER = FlightRecorder(
+    jsonl_path=os.environ.get("CC_TPU_FLIGHT_JSONL"),
+    jsonl_max_bytes=_env_max_bytes(),
+)

@@ -13,7 +13,7 @@ python -m compileall -q cruise_control_tpu tests scripts bench.py bench_scale.py
   bench_sharded.py __graft_entry__.py
 
 echo "== fast tier =="
-python -m pytest tests/ -x -q -m "not slow"
+python -m pytest tests/ -x -q -m "not slow" --durations=25
 
 echo "== chaos tier (seeded fault injection; deterministic, also part of fast tier) =="
 python -m pytest tests/ -x -q -m chaos
@@ -72,6 +72,12 @@ python -m pytest tests/test_fleet.py -x -q -k "not acceptance_32"
 
 echo "== fleet bench (32 tenants: 1-probe-dispatch/0-compile batching contract + tick-p50 vs committed baseline) =="
 python scripts/bench_fleet.py >/dev/null
+
+echo "== slo tier (self-monitoring plane: sampler, windows, spool, SLO burn engine, self-anomaly finder) =="
+python -m pytest tests/test_selfmon.py -x -q -m "not slow"
+
+echo "== selfmon bench (sampler overhead <=1% of warm tick p50, 0 dispatches, induced burn alerts in <=2 periods, 0 quiet false positives) =="
+python scripts/bench_selfmon.py >/dev/null
 
 echo "== bench gate (obs/gate.py: wall/dispatch/violation regression check; incl. the sharded tier vs BENCH_SHARDED_8dev_virtual.json) =="
 python scripts/bench_gate.py

@@ -527,6 +527,7 @@ class TestGateEndToEnd:
             "traces": gate_mod._traces_baseline,
             "replication": gate_mod._replication_baseline,
             "fleet": gate_mod._fleet_baseline,
+            "slo": gate_mod._selfmon_baseline,
         }
         for tier in gate_mod.DEFAULT_TIERS:
             if tier in artifact_baselines and tier not in doc["tiers"]:

@@ -1,0 +1,657 @@
+"""Self-monitoring plane tier (ISSUE 20): sensor time-series, SLO burn-rate
+engine, self-anomaly detection.
+
+Covers the tentpole end to end — the fixed-cadence sampler over the process's
+own registry (windowed via the L0 aggregator, durable via the capped JSONL
+spool), the declarative multi-window burn-rate SLO engine, and the
+``SelfMetricAnomalyFinder`` turning a burning SLO into an anomaly with a
+bounded, symmetric self-heal — plus the satellites: Timer p99/window_n,
+batched aggregator ingestion equivalence, flight-recorder JSONL rotation
+crash-safety, and the new ``SLO`` / ``METRICS?window=`` API surfaces over a
+fully-embedded app.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.core.aggregator import MetricSampleAggregator
+from cruise_control_tpu.core.metricdef import MetricDef
+from cruise_control_tpu.core.sensors import (
+    CONTROLLER_REACTION_TIMER,
+    SensorRegistry,
+    Timer,
+)
+from cruise_control_tpu.detector.anomalies import SloBurnAnomaly
+from cruise_control_tpu.detector.detectors import SelfMetricAnomalyFinder
+from cruise_control_tpu.obs.profiler import DeviceProfiler
+from cruise_control_tpu.obs.recorder import (
+    FlightRecorder,
+    TraceRecord,
+    append_jsonl_capped,
+    read_jsonl,
+)
+from cruise_control_tpu.obs.selfmon import SelfMonitor, read_spool
+from cruise_control_tpu.obs.slo import (
+    SloEngine,
+    SloSpec,
+    WindowPair,
+    shipped_specs,
+)
+
+GOOD = 0.010
+PAIRS = (
+    WindowPair("fast", long_s=10.0, short_s=3.0, threshold=14.4),
+    WindowPair("slow", long_s=60.0, short_s=10.0, threshold=1.0),
+)
+
+
+def make_monitor(tmp_path=None, **kw):
+    reg = SensorRegistry()
+    rec = FlightRecorder()
+    prof = DeviceProfiler()
+    kw.setdefault("num_windows", 10)
+    kw.setdefault("window_ms", 1_000)
+    if tmp_path is not None:
+        kw.setdefault("spool_dir", str(tmp_path / "selfmon"))
+    mon = SelfMonitor(registry=reg, recorder=rec, profiler=prof, **kw)
+    return reg, mon
+
+
+# -- satellite: Timer p99 + window_n ------------------------------------------------
+
+
+class TestTimerPercentiles:
+    def test_snapshot_has_p99_and_window_n(self):
+        t = Timer(window=100)
+        for i in range(100):
+            t.update(i / 1000.0)
+        snap = t.snapshot()
+        assert snap["p99_s"] == pytest.approx(0.099)
+        assert snap["p50_s"] == pytest.approx(0.050)
+        assert snap["window_n"] == 100
+
+    def test_window_n_tracks_partial_fill(self):
+        t = Timer(window=256)
+        for _ in range(3):
+            t.update(0.01)
+        assert t.snapshot()["window_n"] == 3
+
+    def test_incremental_sorted_ring_matches_resort(self):
+        # the percentile ring keeps a sorted view maintained incrementally
+        # after the first snapshot; it must stay identical to a full re-sort
+        # through eviction and duplicates
+        t = Timer(window=16)
+        vals = [((i * 37) % 101) / 1000.0 for i in range(50)]
+        for i, v in enumerate(vals):
+            t.update(v)
+            if i >= 5:
+                t.snapshot()
+                assert t._sorted == sorted(t._ring)
+
+
+# -- satellite: batched aggregator ingestion ----------------------------------------
+
+
+def _three_metric_def():
+    from cruise_control_tpu.core.metricdef import ValueStrategy
+
+    d = MetricDef()
+    d.define("cpu")                       # AVG
+    d.define("disk", strategy=ValueStrategy.LATEST)
+    d.define("nw", strategy=ValueStrategy.MAX)
+    return d
+
+
+class TestBatchedAggregator:
+    def _pair(self):
+        kw = dict(num_windows=4, window_ms=1_000, min_samples_per_window=1,
+                  metric_def=_three_metric_def())
+        return MetricSampleAggregator(**kw), MetricSampleAggregator(**kw)
+
+    def test_add_samples_at_equals_add_sample_loop(self):
+        a, b = self._pair()
+        rows = {"e0": [1.0, 2.0, 3.0], "e1": [4.0, 5.0, 6.0]}
+        rows2 = {"e0": [7.0, 1.0, 1.0], "e1": [2.0, 9.0, 9.0]}
+        for ts, batch in ((500, rows), (700, rows2), (1500, rows), (2500, rows2)):
+            assert a.add_samples_at(ts, batch) == len(batch)
+            for e, vals in batch.items():
+                b.add_sample(e, ts, vals)
+        va, _ = a.aggregate()
+        vb, _ = b.aggregate()
+        assert list(va.entities) == list(vb.entities)
+        np.testing.assert_allclose(va.values, vb.values)
+
+    def test_add_rows_at_skips_stale_window(self):
+        a, _ = self._pair()
+        a.add_samples_at(9_500, {"e0": [1.0, 1.0, 1.0]})
+        rows = a.rows_for(["e0"])
+        # window far behind the retained ring: dropped, not crashed
+        assert a.add_rows_at(1_000, rows, np.ones((1, 3))) == 0
+
+    def test_add_samples_at_rejects_bad_width(self):
+        a, _ = self._pair()
+        with pytest.raises(ValueError, match="expected 3"):
+            a.add_samples_at(500, {"e0": [1.0]})
+
+
+# -- tentpole: the sampler ----------------------------------------------------------
+
+
+class TestSelfMonitor:
+    def test_collect_flattens_every_sensor_kind(self):
+        reg, mon = make_monitor()
+        reg.timer("F.t-timer").update(0.5)
+        reg.gauge("F.g").set(7.0)
+        reg.counter("F.c").inc(3)
+        reg.meter("F.m").mark(4)
+        series = mon.collect(1_000)
+        assert series["F.t-timer.count"] == 1.0
+        assert series["F.t-timer.p99_s"] == 0.5
+        assert series["F.t-timer.window_n"] == 1.0
+        assert series["F.g"] == 7.0
+        assert series["F.c.count"] == 3.0
+        assert series["F.m.total"] == 4.0
+        assert "flight.ring-size" in series
+        assert "profiler.programs" in series
+        assert series["derived.Admission.shed-ratio"] == 0.0
+
+    def test_counter_rate_is_delta_over_period(self):
+        reg, mon = make_monitor()
+        c = reg.counter("F.c")
+        c.inc(10)
+        mon.sample(now_ms=1_000)
+        c.inc(30)
+        series = mon.sample(now_ms=11_000)   # +30 over 10 s
+        assert series["F.c.rate_per_s"] == pytest.approx(3.0)
+
+    def test_derived_shed_ratio_per_period(self):
+        reg, mon = make_monitor()
+        reg.counter("Admission.admitted").inc(90)
+        reg.counter("Admission.shed").inc(10)
+        series = mon.sample(now_ms=1_000)
+        assert series["derived.Admission.shed-ratio"] == pytest.approx(0.10)
+        # next period with no new traffic: ratio is per-period, not cumulative
+        series = mon.sample(now_ms=2_000)
+        assert series["derived.Admission.shed-ratio"] == 0.0
+
+    def test_windows_reuse_l0_semantics(self):
+        reg, mon = make_monitor()
+        g = reg.gauge("F.g")
+        for w in range(4):
+            g.set(float(w))
+            mon.sample(now_ms=500 + w * 1_000)
+        doc = mon.windows(max_windows=2)
+        # current window excluded (L0 contract): stable windows only
+        assert len(doc["window_ids"]) == 2
+        assert doc["series"]["F.g"] == [1.0, 2.0]
+
+    def test_window_values_trailing_cutoff(self):
+        reg, mon = make_monitor()
+        g = reg.gauge("F.g")
+        for w in range(5):
+            g.set(float(w))
+            mon.sample(now_ms=(w + 1) * 1_000)
+        assert mon.window_values("F.g", 2.0, now_ms=5_000) == [2.0, 3.0, 4.0]
+
+    def test_spool_written_and_rotated(self, tmp_path):
+        reg, mon = make_monitor(tmp_path, spool_max_bytes=1_000)
+        reg.gauge("F.g").set(1.0)
+        for w in range(8):
+            mon.sample(now_ms=(w + 1) * 1_000)
+        mon.stop()
+        records = read_spool(mon.spool_path)
+        assert records and records[-1]["schema"] == 1
+        assert records[-1]["series"]["F.g"] == 1.0
+        assert mon.spool_rotations >= 1
+        assert os.path.exists(mon.spool_path + ".1")
+        # rotated file is itself valid JSONL
+        assert read_spool(mon.spool_path + ".1")
+
+    def test_spool_crash_truncated_tail_skipped(self, tmp_path):
+        reg, mon = make_monitor(tmp_path)
+        reg.gauge("F.g").set(1.0)
+        mon.sample(now_ms=1_000)
+        mon.sample(now_ms=2_000)
+        mon.stop()
+        with open(mon.spool_path, "a") as f:
+            f.write('{"schema":1,"ts_ms":3000,"ser')   # torn mid-crash
+        records = read_spool(mon.spool_path)
+        assert len(records) == 2
+
+    def test_sampler_is_host_only(self):
+        reg, mon = make_monitor()
+        reg.timer("F.t-timer").update(0.5)
+        mark = mon.profiler.mark()
+        for w in range(5):
+            mon.sample(now_ms=(w + 1) * 1_000)
+        assert mon.profiler.mark() == mark
+
+    def test_background_thread_lifecycle(self):
+        _, mon = make_monitor(interval_s=0.01)
+        mon.start()
+        deadline = time.monotonic() + 5.0
+        while mon.samples < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        mon.stop()
+        assert mon.samples >= 3
+        assert not mon._thread
+
+    def test_status_block(self, tmp_path):
+        reg, mon = make_monitor(tmp_path)
+        mon.sample(now_ms=1_000)
+        st = mon.status()
+        assert st["enabled"] and st["samples"] == 1
+        assert st["seriesCount"] > 0
+        assert st["spool"]["path"] == mon.spool_path
+        mon.stop()
+
+
+# -- satellite: flight-recorder JSONL rotation --------------------------------------
+
+
+class TestFlightJsonlRotation:
+    def test_append_jsonl_capped_rotates(self, tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        line = json.dumps(TraceRecord(
+            kind="optimize", trace_id="t", started_at=0.0, duration_s=0.1,
+            platform="cpu", attrs={"pad": "x" * 80},
+        ).to_dict())
+        rotations = 0
+        for _ in range(50):
+            rotations += append_jsonl_capped(path, line, max_bytes=1_000)
+        assert rotations >= 3
+        assert os.path.getsize(path) <= 1_000
+        assert os.path.exists(path + ".1")
+        # both generations stay parseable — rotation is rename, not truncate
+        assert read_jsonl(path) and read_jsonl(path + ".1")
+
+    def test_recorder_sink_rotation_crash_safe(self, tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        rec = FlightRecorder(jsonl_path=path, jsonl_max_bytes=2_000)
+        for i in range(60):
+            rec.record(TraceRecord(
+                kind="optimize", trace_id=f"t-{i}", started_at=0.0,
+                duration_s=0.1, platform="cpu",
+                attrs={"pad": "y" * 64},
+            ))
+        assert rec.snapshot()["jsonl_rotations"] >= 1
+        # crash-safety: torn tail on the active file must not poison reads
+        with open(path, "a") as f:
+            f.write('{"kind": "opt')
+        kept = read_jsonl(path)
+        assert kept
+        assert all(r.kind == "optimize" for r in kept)
+        # every surviving record across generations is intact
+        older = read_jsonl(path + ".1")
+        assert older and all(r.trace_id.startswith("t-") for r in older)
+
+
+# -- tentpole: the SLO engine -------------------------------------------------------
+
+
+class FakeSource:
+    """Minimal selfmon duck-type: scripted per-series sample history."""
+
+    def __init__(self):
+        self.hist = {}
+
+    def add(self, series, ts_ms, value):
+        self.hist.setdefault(series, []).append((ts_ms, value))
+
+    def latest(self, series):
+        h = self.hist.get(series)
+        return h[-1][1] if h else None
+
+    def window_values(self, series, window_s, now_ms=None):
+        cutoff = now_ms - int(window_s * 1000)
+        return [v for ts, v in self.hist.get(series, ())
+                if cutoff <= ts <= now_ms]
+
+
+class TestSloEngine:
+    def _engine(self, src, objective=0.05, budget=0.01):
+        spec = SloSpec(name="lat", series="s", objective=objective,
+                       budget=budget)
+        return SloEngine([spec], src, pairs=list(PAIRS))
+
+    def test_no_data_never_fires(self):
+        src = FakeSource()
+        eng = self._engine(src)
+        statuses = eng.evaluate(now_ms=1_000)
+        assert statuses[0]["value"] is None
+        assert not eng.firing()
+
+    def test_quiet_run_zero_alerts(self):
+        src = FakeSource()
+        eng = self._engine(src)
+        for w in range(30):
+            src.add("s", (w + 1) * 1_000, GOOD)
+            eng.evaluate(now_ms=(w + 1) * 1_000)
+        assert not eng.firing()
+
+    def test_burn_requires_both_windows(self):
+        src = FakeSource()
+        eng = self._engine(src)
+        for w in range(30):
+            src.add("s", (w + 1) * 1_000, GOOD)
+        # one bad sample: short window burns hot, long window still under
+        # threshold — the multi-window guard against one-blip paging
+        src.add("s", 31_000, 0.5)
+        eng.evaluate(now_ms=31_000)
+        fast = [a for a in eng.firing() if a.pair == "fast"]
+        assert not fast
+        # a second bad sample pushes the long window over: fires
+        src.add("s", 32_000, 0.5)
+        eng.evaluate(now_ms=32_000)
+        fast = [a for a in eng.firing() if a.pair == "fast"]
+        assert fast and fast[0].burn_long >= 14.4
+
+    def test_recovered_incident_stops_firing(self):
+        src = FakeSource()
+        eng = self._engine(src)
+        for w in range(10):
+            src.add("s", (w + 1) * 1_000, 0.5)
+        eng.evaluate(now_ms=10_000)
+        assert eng.firing()
+        # good samples refill the short window; the alert stops even though
+        # the long window still remembers the damage
+        for w in range(10, 22):
+            src.add("s", (w + 1) * 1_000, GOOD)
+        eng.evaluate(now_ms=22_000)
+        assert not [a for a in eng.firing() if a.pair == "fast"]
+
+    def test_since_ms_sticks_across_evaluations(self):
+        src = FakeSource()
+        eng = self._engine(src)
+        for w in range(10):
+            src.add("s", (w + 1) * 1_000, 0.5)
+        eng.evaluate(now_ms=10_000)
+        first = [a for a in eng.firing() if a.pair == "fast"][0].since_ms
+        src.add("s", 11_000, 0.5)
+        eng.evaluate(now_ms=11_000)
+        assert [a for a in eng.firing() if a.pair == "fast"][0].since_ms == first
+
+    def test_ge_comparison(self):
+        src = FakeSource()
+        spec = SloSpec(name="avail", series="s", objective=0.99,
+                       comparison="ge", budget=0.01)
+        eng = SloEngine([spec], src, pairs=list(PAIRS))
+        for w in range(10):
+            src.add("s", (w + 1) * 1_000, 0.5)     # far below the floor
+        eng.evaluate(now_ms=10_000)
+        assert eng.firing()
+
+    def test_shipped_specs_bind_config(self):
+        cfg = {
+            "slo.burn.budget": 0.02,
+            "slo.reaction.p99.objective.s": 0.123,
+            "slo.shed.ratio.objective": 0.05,
+            "slo.degraded.ratio.objective": 0.05,
+            "slo.dispatch.budget": 7.0,
+            "slo.recompile.objective": 0.0,
+            "slo.replication.staleness.objective.ms": 2000.0,
+        }
+        specs = {s.name: s for s in shipped_specs(cfg.get)}
+        assert len(specs) == 6
+        assert specs["reaction-latency-p99"].objective == 0.123
+        assert specs["reaction-latency-p99"].budget == 0.02
+        assert specs["warm-recompiles"].series == "flight.compile-events.delta"
+
+    def test_engine_against_real_selfmonitor(self):
+        # the duck-typed source contract, proven against the real sampler
+        reg, mon = make_monitor()
+        t = reg.timer(CONTROLLER_REACTION_TIMER)
+        spec = SloSpec(name="lat",
+                       series=f"{CONTROLLER_REACTION_TIMER}.p99_s",
+                       objective=0.05, budget=0.01)
+        eng = SloEngine([spec], mon, pairs=list(PAIRS))
+        for w in range(10):
+            t.update(0.5)
+            mon.sample(now_ms=(w + 1) * 1_000)
+            eng.evaluate(now_ms=(w + 1) * 1_000)
+        assert eng.firing()
+        assert eng.status()["firing"] >= 1
+
+
+# -- tentpole: the self-anomaly finder ----------------------------------------------
+
+
+class StubTarget:
+    def __init__(self):
+        self.paused = False
+        self.pause_reason = None
+
+    def pause(self, reason="operator request"):
+        self.paused, self.pause_reason = True, reason
+
+    def resume(self, reason="operator request"):
+        self.paused, self.pause_reason = False, reason
+
+
+def burning_engine(on=True):
+    src = FakeSource()
+    spec = SloSpec(name="lat", series="s", objective=0.05, budget=0.01)
+    eng = SloEngine([spec], src, pairs=list(PAIRS))
+    for w in range(10):
+        src.add("s", (w + 1) * 1_000, 0.5 if on else GOOD)
+    return eng, src
+
+
+class TestSelfMetricAnomalyFinder:
+    def _finder(self, eng, **kw):
+        clock = [0.0]
+        kw.setdefault("cooldown_s", 300.0)
+        f = SelfMetricAnomalyFinder(eng, now=lambda: clock[0], **kw)
+        return f, clock
+
+    def test_emits_on_burn_then_cooldown_dedups(self):
+        eng, src = burning_engine()
+        eng._now_ms = lambda: 10_000
+        finder, clock = self._finder(eng)
+        assert len(finder.run()) == 1
+        # same firing set, inside cooldown: one incident, one anomaly
+        clock[0] = 30.0
+        assert finder.run() == []
+        # cooldown expired while still burning: re-page
+        clock[0] = 400.0
+        assert len(finder.run()) == 1
+
+    def test_new_pair_reemits_mid_cooldown(self):
+        eng, src = burning_engine()
+        eng._now_ms = lambda: 10_000
+        finder, clock = self._finder(eng)
+        assert len(finder.run()) == 1
+        # a second objective starts burning: new information, new anomaly
+        eng.specs.append(
+            SloSpec(name="lat2", series="s2", objective=0.05, budget=0.01)
+        )
+        for w in range(10):
+            src.add("s2", (w + 1) * 1_000, 0.5)
+        clock[0] = 30.0
+        assert len(finder.run()) == 1
+
+    def test_heal_pauses_and_auto_resumes(self):
+        eng, src = burning_engine()
+        now = [10_000]
+        eng._now_ms = lambda: now[0]
+        ctrl, fleet = StubTarget(), StubTarget()
+        finder, clock = self._finder(eng, controller=ctrl, fleet=fleet)
+        (anomaly,) = finder.run()
+        fix = anomaly.fix_with(None)
+        assert set(fix["actions"]) == {"controller-paused", "fleet-drains-paused"}
+        assert ctrl.paused and fleet.paused
+        assert ctrl.pause_reason.startswith("slo-burn")
+        # recovery: short window refills with good samples, alerts clear,
+        # the finder resumes what it paused
+        for w in range(10, 25):
+            src.add("s", (w + 1) * 1_000, GOOD)
+        now[0] = 25_000
+        assert finder.run() == []
+        assert not ctrl.paused and not fleet.paused
+        assert finder.resumes == 2
+
+    def test_operator_pause_never_touched(self):
+        eng, src = burning_engine(on=False)
+        eng._now_ms = lambda: 10_000
+        ctrl = StubTarget()
+        ctrl.pause("operator request")
+        finder, _ = self._finder(eng, controller=ctrl)
+        assert finder.run() == []
+        assert ctrl.paused     # quiet engine resumes only its own pauses
+
+    def test_anomaly_without_handles_is_surface_only(self):
+        anomaly = SloBurnAnomaly(alerts=[{"slo": "lat", "pair": "fast"}])
+        assert anomaly.fix_with(None)["actions"] == []
+        assert "lat/fast" in anomaly.description()
+
+
+# -- API surfaces over the embedded app ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served_app(tmp_path_factory):
+    from cruise_control_tpu.app import CruiseControlTpuApp
+    from cruise_control_tpu.backend import FakeClusterBackend
+
+    backend = FakeClusterBackend()
+    for b in range(4):
+        backend.add_broker(b, rack=str(b % 2))
+    for p in range(8):
+        backend.create_partition(
+            ("T", p), [p % 2, (p % 2 + 1) % 4], load=[1.5, 4e3, 6e3, 3e4]
+        )
+    jdir = str(tmp_path_factory.mktemp("journal"))
+    props = {
+        "metric.sampling.interval.ms": 3_600_000,
+        "anomaly.detection.interval.ms": 3_600_000,
+        "anomaly.detection.initial.pass": False,
+        "webserver.http.port": 0,
+        "journal.dir": jdir,
+        "selfmon.sample.interval.ms": 3_600_000,   # manual sampling below
+        "selfmon.window.ms": 1_000,
+        "sample.store.class":
+            "cruise_control_tpu.monitor.samplestore.NoopSampleStore",
+    }
+    app = CruiseControlTpuApp(props, backend=backend)
+    app.start(serve_http=True)
+    for w in range(4):
+        app.selfmon.sample()
+    yield app
+    app.stop()
+
+
+def _get(app, path):
+    url = f"http://127.0.0.1:{app.port}/kafkacruisecontrol/{path}"
+    return urllib.request.urlopen(url)
+
+
+class TestSloApi:
+    def test_app_wires_the_plane(self, served_app):
+        assert served_app.selfmon is not None
+        assert served_app.slo_engine is not None
+        finders = [d for d, _ in served_app.anomaly_manager.detectors
+                   if isinstance(d, SelfMetricAnomalyFinder)]
+        assert len(finders) == 1
+        assert finders[0].controller is served_app.controller
+
+    def test_slo_endpoint(self, served_app):
+        body = json.load(_get(served_app, "slo"))
+        assert body["enabled"] is True
+        assert {s["name"] for s in body["specs"]} >= {
+            "reaction-latency-p99", "shed-ratio", "warm-recompiles",
+        }
+        assert {p["name"] for p in body["pairs"]} == {"fast", "slow"}
+        assert body["selfmon"]["samples"] >= 4
+
+    def test_slo_endpoint_narrowed(self, served_app):
+        body = json.load(_get(served_app, "slo?slo=shed-ratio"))
+        assert body["slo"] == "shed-ratio"
+        assert body["series"] == "derived.Admission.shed-ratio"
+
+    def test_slo_endpoint_unknown_404s(self, served_app):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(served_app, "slo?slo=nope")
+        assert e.value.code == 404
+
+    def test_state_has_selfmonitor_block(self, served_app):
+        body = json.load(_get(served_app, "state"))
+        block = body["SelfMonitor"]
+        assert block["samples"] >= 4
+        assert "evaluations" in block["slo"]
+
+    def test_metrics_window_param(self, served_app):
+        from cruise_control_tpu.obs.exporter import parse_exposition
+
+        page = _get(served_app, "metrics?window=3").read().decode()
+        parsed = parse_exposition(page)          # strict: must stay lint-clean
+        assert "cruise_control_tpu_slo_objective" in parsed
+        assert "cruise_control_tpu_selfmon_window_value" in parsed
+        # without the param the (potentially huge) window family is absent
+        plain = _get(served_app, "metrics").read().decode()
+        assert "selfmon_window_value" not in plain
+        assert "cruise_control_tpu_slo_objective" in plain
+
+    def test_client_slo_method(self, served_app):
+        from cruise_control_tpu.client import CruiseControlClient
+
+        client = CruiseControlClient(f"http://127.0.0.1:{served_app.port}")
+        body = client.slo()
+        assert body["enabled"] is True
+        one = client.slo(name="warm-recompiles")
+        assert one["slo"] == "warm-recompiles"
+
+    def test_spool_lands_under_journal_dir(self, served_app):
+        spool = served_app.selfmon.spool_path
+        assert spool and os.path.exists(spool)
+        assert read_spool(spool)
+
+    def test_stop_clears_global_engine(self):
+        # a dedicated app (not the module fixture — stop() is the test)
+        from cruise_control_tpu.app import CruiseControlTpuApp
+        from cruise_control_tpu.backend import FakeClusterBackend
+        from cruise_control_tpu.obs import slo as slo_mod
+
+        backend = FakeClusterBackend()
+        for b in range(3):
+            backend.add_broker(b, rack=str(b))
+        backend.create_partition(("T", 0), [0, 1], load=[1.5, 4e3, 6e3, 3e4])
+        app = CruiseControlTpuApp({
+            "metric.sampling.interval.ms": 3_600_000,
+            "anomaly.detection.interval.ms": 3_600_000,
+            "anomaly.detection.initial.pass": False,
+            "selfmon.sample.interval.ms": 3_600_000,
+            "sample.store.class":
+                "cruise_control_tpu.monitor.samplestore.NoopSampleStore",
+        }, backend=backend)
+        assert slo_mod.GLOBAL_ENGINE is app.slo_engine
+        app.start(serve_http=False)
+        app.stop()
+        assert slo_mod.GLOBAL_ENGINE is None
+
+    def test_selfmon_disable_flag(self):
+        from cruise_control_tpu.app import CruiseControlTpuApp
+        from cruise_control_tpu.backend import FakeClusterBackend
+
+        backend = FakeClusterBackend()
+        for b in range(3):
+            backend.add_broker(b, rack=str(b))
+        backend.create_partition(("T", 0), [0, 1], load=[1.5, 4e3, 6e3, 3e4])
+        app = CruiseControlTpuApp({
+            "metric.sampling.interval.ms": 3_600_000,
+            "anomaly.detection.interval.ms": 3_600_000,
+            "anomaly.detection.initial.pass": False,
+            "selfmon.enable": False,
+            "sample.store.class":
+                "cruise_control_tpu.monitor.samplestore.NoopSampleStore",
+        }, backend=backend)
+        assert app.selfmon is None and app.slo_engine is None
+        finders = [d for d, _ in app.anomaly_manager.detectors
+                   if isinstance(d, SelfMetricAnomalyFinder)]
+        assert not finders
+        app.kill()

@@ -12,6 +12,7 @@ Acceptance criteria covered here:
   optimize trace and the execution trace via ``GET /TRACES?parent_id=``.
 """
 
+import re
 import threading
 
 import jax
@@ -35,6 +36,16 @@ from cruise_control_tpu.obs.recorder import (
 
 
 # -- exposition renderer -------------------------------------------------------------
+
+
+def _unescape_label(value: str) -> str:
+    """Reverse of the exporter's label escaping (the parser keeps values in
+    their on-the-wire escaped form)."""
+    return re.sub(
+        r'\\(n|"|\\)',
+        lambda m: {"n": "\n", '"': '"', "\\": "\\"}[m.group(1)],
+        value,
+    )
 
 
 class TestExporterRender:
@@ -79,7 +90,7 @@ class TestExporterRender:
             dict(labels)["stat"]
             for labels, _ in parsed["cruise_control_tpu_timer_seconds"]["samples"]
         }
-        assert stats == {"mean", "max", "last", "p50", "p95"}
+        assert stats == {"mean", "max", "last", "p50", "p95", "p99"}
 
     def test_label_escaping_survives_parse(self):
         reg = SensorRegistry()
@@ -90,6 +101,48 @@ class TestExporterRender:
         parsed = parse_exposition(text)   # must not raise
         samples = parsed["cruise_control_tpu_counter_total"]["samples"]
         assert len(samples) == 1
+
+    @pytest.mark.parametrize("leaf", [
+        "embedded\nnewline",
+        'embedded"quote',
+        "embedded\\backslash",
+        "trailing-backslash\\",
+    ])
+    def test_each_escape_char_round_trips(self, leaf):
+        # one edge case per escape the spec defines (\n, \", \\), plus the
+        # nastiest composition: a value ENDING in backslash, which a sloppy
+        # renderer turns into an escaped closing quote
+        reg = SensorRegistry()
+        reg.gauge(f"Edge.{leaf}").set(1.0)
+        text = render_prometheus(
+            registry=reg, recorder=FlightRecorder(), profiler=DeviceProfiler()
+        )
+        parsed = parse_exposition(text)
+        samples = parsed["cruise_control_tpu_gauge"]["samples"]
+        labels = dict(samples[0][0])
+        assert _unescape_label(labels["sensor"]) == leaf
+        assert _unescape_label(labels["family"]) == "Edge"
+
+    def test_prefix_colliding_family_stays_a_label(self):
+        # a sensor family named exactly like an exported metric family must
+        # not forge new samples under that metric name: dotted families render
+        # as LABEL VALUES, never as metric names, so the collision is inert
+        reg = SensorRegistry()
+        reg.counter("cruise_control_tpu_counter_total.requests").inc(2)
+        reg.gauge("cruise_control_tpu_gauge.depth").set(7.0)
+        text = render_prometheus(
+            registry=reg, recorder=FlightRecorder(), profiler=DeviceProfiler()
+        )
+        parsed = parse_exposition(text)   # duplicate-series check must pass
+        counters = parsed["cruise_control_tpu_counter_total"]["samples"]
+        assert [(dict(ls), v) for ls, v in counters] == [(
+            {"family": "cruise_control_tpu_counter_total",
+             "sensor": "requests"}, 2.0,
+        )]
+        gauges = parsed["cruise_control_tpu_gauge"]["samples"]
+        assert (dict(gauges[0][0]), gauges[0][1]) == (
+            {"family": "cruise_control_tpu_gauge", "sensor": "depth"}, 7.0,
+        )
 
     def test_flight_recorder_summary_rendered(self):
         rec = FlightRecorder(capacity=4)
